@@ -1,0 +1,29 @@
+"""mmlspark_tpu — a TPU-native ML pipeline framework.
+
+A brand-new framework with the capabilities of MMLSpark (Microsoft ML for
+Apache Spark v0.5), re-designed TPU-first:
+
+- Columnar, partitioned ``Frame`` data pipelines instead of Spark DataFrames.
+- ``Estimator``/``Transformer``/``Pipeline`` contracts with a JSON-serializable
+  ``Param`` DSL (reference: ``core/contracts/src/main/scala/Params.scala``).
+- Schema-carried metadata: categorical levels and score-column tags
+  (reference: ``core/schema/src/main/scala/{Categoricals,SparkSchema}.scala``).
+- JAX/XLA compute: learners JIT to XLA; distributed training via ``jax.sharding``
+  meshes with collectives over ICI/DCN instead of MPI
+  (reference: ``cntk-train/src/main/scala/CommandBuilders.scala``).
+- Pallas kernels for fused image preprocessing instead of per-row OpenCV JNI
+  (reference: ``image-transformer/src/main/scala/ImageTransformer.scala``).
+"""
+
+__version__ = "0.1.0"
+
+from mmlspark_tpu.core.frame import Frame  # noqa: F401
+from mmlspark_tpu.core.pipeline import (  # noqa: F401
+    Estimator,
+    Model,
+    Pipeline,
+    PipelineModel,
+    Transformer,
+)
+from mmlspark_tpu.core.params import Param, Params  # noqa: F401
+from mmlspark_tpu.core.serialization import load_stage, save_stage  # noqa: F401

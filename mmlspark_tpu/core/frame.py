@@ -1,0 +1,368 @@
+"""Frame: a partitioned, columnar, host-resident dataset that streams to TPU.
+
+This is the JVM-free re-expression of the Spark DataFrame surface the
+reference's ML layer needs (select/withColumn/na.drop/cache/repartition —
+see SURVEY.md §7 "Hard parts"). Partitions are host-local dicts of numpy
+arrays; ops are eager per-partition (no Catalyst rebuild). Device hand-off
+happens via :meth:`Frame.batches` and ``mmlspark_tpu.parallel.data.device_put_sharded``
+which stream stacked batches into sharded ``jax.Array``s — the TPU-native
+equivalent of the reference's broadcast + ``mapPartitions`` minibatch loop
+(``cntk-model/src/main/scala/CNTKModel.scala:215-221``).
+
+Storage conventions per DType:
+  numeric  -> 1-D ndarray of the numpy dtype
+  STRING   -> 1-D object ndarray of str (None for missing)
+  VECTOR   -> 2-D float32 ndarray (n_rows, dim)
+  IMAGE    -> 1-D object ndarray of schema.ImageValue
+  BINARY   -> 1-D object ndarray of bytes
+  TOKENS   -> 1-D object ndarray of list[str]
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.schema import ColumnSchema, DType, Schema, SchemaError
+
+Partition = Dict[str, np.ndarray]
+
+
+def _infer_dtype(arr: np.ndarray) -> Tuple[DType, Optional[int]]:
+    if arr.ndim == 2:
+        return DType.VECTOR, int(arr.shape[1])
+    if arr.dtype == np.bool_:
+        return DType.BOOL, None
+    if np.issubdtype(arr.dtype, np.integer):
+        return (DType.INT32 if arr.dtype.itemsize <= 4 else DType.INT64), None
+    if np.issubdtype(arr.dtype, np.floating):
+        return (DType.FLOAT32 if arr.dtype.itemsize <= 4 else DType.FLOAT64), None
+    # object arrays: inspect first non-null
+    for v in arr:
+        if v is None:
+            continue
+        if isinstance(v, str):
+            return DType.STRING, None
+        if isinstance(v, (bool, np.bool_)):
+            return DType.BOOL, None
+        if isinstance(v, (int, float, np.number)):
+            return DType.FLOAT64, None
+        if isinstance(v, (bytes, bytearray)):
+            return DType.BINARY, None
+        if isinstance(v, list):
+            return DType.TOKENS, None
+        from mmlspark_tpu.core.schema import ImageValue
+        if isinstance(v, ImageValue):
+            return DType.IMAGE, None
+        if isinstance(v, np.ndarray):
+            return DType.VECTOR, int(v.shape[0])
+    return DType.STRING, None
+
+
+def _normalize(values: Any, dtype: Optional[DType] = None) -> Tuple[np.ndarray, DType, Optional[int]]:
+    """Coerce a python sequence / ndarray into canonical column storage."""
+    if isinstance(values, np.ndarray) and values.dtype != np.object_:
+        arr = values
+    else:
+        lst = list(values)
+        if lst and isinstance(lst[0], np.ndarray) and dtype in (None, DType.VECTOR):
+            arr = np.stack([np.asarray(v, dtype=np.float32) for v in lst])
+        else:
+            numeric = bool(lst) and all(
+                v is None or isinstance(v, (int, float, bool, np.number)) for v in lst)
+            has_none = any(v is None for v in lst)
+            try:
+                if dtype is not None and dtype.is_numeric:
+                    if has_none:  # missing numeric -> NaN (na_drop can remove it)
+                        arr = np.asarray([np.nan if v is None else v for v in lst],
+                                         dtype=np.float64)
+                    else:
+                        arr = np.asarray(lst, dtype=dtype.numpy_dtype)
+                elif dtype is None and numeric:
+                    if has_none:
+                        arr = np.asarray([np.nan if v is None else v for v in lst],
+                                         dtype=np.float64)
+                    else:
+                        arr = np.asarray(lst)
+                else:
+                    raise ValueError
+            except (ValueError, TypeError):
+                arr = np.empty(len(lst), dtype=np.object_)
+                for i, v in enumerate(lst):
+                    arr[i] = v
+    if dtype is None:
+        dtype, dim = _infer_dtype(arr)
+    else:
+        dim = int(arr.shape[1]) if arr.ndim == 2 else None
+    if dtype == DType.VECTOR and arr.ndim == 2 and arr.dtype != np.float32:
+        arr = arr.astype(np.float32)
+    elif dtype.is_numeric and arr.dtype != dtype.numpy_dtype and arr.dtype != np.object_:
+        if (np.issubdtype(arr.dtype, np.floating)
+                and np.issubdtype(dtype.numpy_dtype, np.integer)
+                and np.isnan(arr).any()):
+            dtype = DType.FLOAT64  # NaN is unrepresentable in ints; stay float
+            arr = arr.astype(np.float64)
+        else:
+            arr = arr.astype(dtype.numpy_dtype)
+    return arr, dtype, dim
+
+
+class Frame:
+    """Partitioned columnar dataset. Immutable-by-convention: ops return new Frames."""
+
+    def __init__(self, schema: Schema, partitions: List[Partition]):
+        self.schema = schema
+        self.partitions = partitions if partitions else [
+            {c.name: _empty_column(c) for c in schema}]
+        for part in self.partitions:
+            lens = {len(part[c.name]) for c in schema}
+            if len(lens) > 1:
+                raise SchemaError(f"ragged partition: column lengths {lens}")
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def from_dict(data: Dict[str, Any], num_partitions: int = 1,
+                  schema: Optional[Schema] = None) -> "Frame":
+        cols: Dict[str, np.ndarray] = {}
+        schemas: List[ColumnSchema] = []
+        for name, values in data.items():
+            want = schema[name].dtype if schema is not None and name in schema else None
+            arr, dtype, dim = _normalize(values, want)
+            cols[name] = arr
+            base = schema[name] if schema is not None and name in schema else None
+            md = dict(base.metadata) if base else {}
+            schemas.append(ColumnSchema(name, dtype, dim, md))
+        n = len(next(iter(cols.values()))) if cols else 0
+        frame = Frame(Schema(schemas), [cols])
+        return frame.repartition(num_partitions) if num_partitions > 1 and n else frame
+
+    @staticmethod
+    def from_rows(rows: Sequence[Dict[str, Any]], num_partitions: int = 1) -> "Frame":
+        if not rows:
+            raise SchemaError("from_rows needs at least one row")
+        names = list(rows[0].keys())
+        return Frame.from_dict({n: [r[n] for r in rows] for n in names}, num_partitions)
+
+    @staticmethod
+    def concat(frames: Sequence["Frame"]) -> "Frame":
+        if not frames:
+            raise SchemaError("concat requires at least one frame")
+        first = frames[0]
+        for f in frames[1:]:
+            if f.schema.names != first.schema.names:
+                raise SchemaError(
+                    f"concat schema mismatch: {f.schema.names} vs {first.schema.names}")
+        parts = [p for f in frames for p in f.partitions]
+        return Frame(first.schema, parts)
+
+    # -- basic info --------------------------------------------------------
+    @property
+    def num_partitions(self) -> int:
+        return len(self.partitions)
+
+    def count(self) -> int:
+        first = self.schema.names[0] if self.schema.names else None
+        if first is None:
+            return 0
+        return sum(len(p[first]) for p in self.partitions)
+
+    def __len__(self) -> int:
+        return self.count()
+
+    @property
+    def columns(self) -> List[str]:
+        return self.schema.names
+
+    # -- column access -----------------------------------------------------
+    def column(self, name: str) -> np.ndarray:
+        """Concatenate one column across partitions (driver-side collect)."""
+        self.schema[name]
+        arrs = [p[name] for p in self.partitions]
+        if len(arrs) == 1:
+            return arrs[0]
+        return np.concatenate(arrs, axis=0)
+
+    def collect(self) -> Dict[str, np.ndarray]:
+        return {n: self.column(n) for n in self.schema.names}
+
+    def head(self, n: int = 5) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for part in self.partitions:
+            take = min(n - len(out), len(part[self.schema.names[0]]))
+            for i in range(take):
+                out.append({name: part[name][i] for name in self.schema.names})
+            if len(out) >= n:
+                break
+        return out
+
+    # -- relational ops ----------------------------------------------------
+    def select(self, *names: str) -> "Frame":
+        names = list(names[0]) if len(names) == 1 and isinstance(names[0], (list, tuple)) else list(names)
+        schema = self.schema.select(names)
+        parts = [{n: p[n] for n in names} for p in self.partitions]
+        return Frame(schema, parts)
+
+    def drop(self, *names: str) -> "Frame":
+        keep = [n for n in self.schema.names if n not in set(names)]
+        return self.select(*keep)
+
+    def rename(self, mapping: Dict[str, str]) -> "Frame":
+        schema = Schema([c.renamed(mapping.get(c.name, c.name)) for c in self.schema])
+        parts = [{mapping.get(n, n): p[n] for n in self.schema.names}
+                 for p in self.partitions]
+        return Frame(schema, parts)
+
+    def with_column(self, col: ColumnSchema,
+                    fn: Callable[[Partition], np.ndarray]) -> "Frame":
+        """Add/replace a column; ``fn`` maps a partition dict to the new array."""
+        schema = self.schema.add(col)
+        parts = []
+        for p in self.partitions:
+            arr, _, dim = _normalize(fn(p), col.dtype)
+            if col.dtype == DType.VECTOR and col.dim is None and dim is not None:
+                schema = schema.add(ColumnSchema(col.name, col.dtype, dim, col.metadata))
+            q = dict(p)
+            q[col.name] = arr
+            parts.append(q)
+        return Frame(schema, parts)
+
+    def with_column_values(self, col: ColumnSchema, values: Any) -> "Frame":
+        """Add/replace a column from a full-length array, split across partitions."""
+        arr, _, dim = _normalize(values, col.dtype)
+        if col.dtype == DType.VECTOR and col.dim is None and dim is not None:
+            col = ColumnSchema(col.name, col.dtype, dim, col.metadata)
+        if len(arr) != self.count():
+            raise SchemaError(f"column length {len(arr)} != frame length {self.count()}")
+        schema = self.schema.add(col)
+        parts, off = [], 0
+        for p in self.partitions:
+            n = len(p[self.schema.names[0]]) if self.schema.names else len(arr)
+            q = dict(p)
+            q[col.name] = arr[off:off + n]
+            parts.append(q)
+            off += n
+        return Frame(schema, parts)
+
+    def with_metadata(self, name: str, **meta) -> "Frame":
+        return Frame(self.schema.add(self.schema[name].with_meta(**meta)),
+                     self.partitions)
+
+    def map_partitions(self, schema: Schema,
+                       fn: Callable[[Partition], Partition]) -> "Frame":
+        return Frame(schema, [fn(dict(p)) for p in self.partitions])
+
+    def filter(self, mask_fn: Callable[[Partition], np.ndarray]) -> "Frame":
+        parts = []
+        for p in self.partitions:
+            mask = np.asarray(mask_fn(p), dtype=bool)
+            parts.append({n: p[n][mask] for n in self.schema.names})
+        return Frame(self.schema, parts)
+
+    def na_drop(self, cols: Optional[Sequence[str]] = None) -> "Frame":
+        """Drop rows with None/NaN in any of ``cols`` (default: all columns)."""
+        cols = list(cols) if cols is not None else self.schema.names
+
+        def mask(p: Partition) -> np.ndarray:
+            n = len(p[self.schema.names[0]])
+            keep = np.ones(n, dtype=bool)
+            for c in cols:
+                arr = p[c]
+                if arr.dtype == np.object_:
+                    keep &= np.array([v is not None for v in arr])
+                elif np.issubdtype(arr.dtype, np.floating):
+                    if arr.ndim == 2:
+                        keep &= ~np.isnan(arr).any(axis=1)
+                    else:
+                        keep &= ~np.isnan(arr)
+            return keep
+        return self.filter(mask)
+
+    def distinct_values(self, col: str) -> List[Any]:
+        seen, out = set(), []
+        for p in self.partitions:
+            for v in p[col]:
+                key = v.item() if isinstance(v, np.generic) else v
+                if isinstance(key, float) and math.isnan(key):
+                    key = "__nan__"
+                if key not in seen:
+                    seen.add(key)
+                    out.append(v)
+        return out
+
+    def union(self, other: "Frame") -> "Frame":
+        if other.schema.names != self.schema.names:
+            raise SchemaError("union requires identical column names")
+        return Frame(self.schema, self.partitions + other.partitions)
+
+    # -- partitioning (reference pipeline-stages/Repartition.scala) --------
+    def repartition(self, n: int) -> "Frame":
+        if n <= 0:
+            raise SchemaError("repartition requires n >= 1")
+        cols = self.collect()
+        total = self.count()
+        bounds = np.linspace(0, total, n + 1).astype(int)
+        parts = [{name: arr[bounds[i]:bounds[i + 1]] for name, arr in cols.items()}
+                 for i in range(n)]
+        return Frame(self.schema, parts)
+
+    def coalesce(self, n: int) -> "Frame":
+        if n >= self.num_partitions:
+            return self
+        groups = np.array_split(np.arange(self.num_partitions), n)
+        parts = []
+        for g in groups:
+            sub = [self.partitions[i] for i in g]
+            parts.append({name: np.concatenate([p[name] for p in sub], axis=0)
+                          for name in self.schema.names})
+        return Frame(self.schema, parts)
+
+    def cache(self) -> "Frame":
+        """Partitions are already materialized host arrays; kept for API parity
+        with the reference's CheckpointData persist (CheckpointData.scala:31-70)."""
+        return self
+
+    def unpersist(self) -> "Frame":
+        return self
+
+    # -- device streaming --------------------------------------------------
+    def batches(self, batch_size: int, cols: Optional[Sequence[str]] = None,
+                drop_remainder: bool = False) -> Iterator[Dict[str, np.ndarray]]:
+        """Yield stacked numpy minibatches across partition boundaries.
+
+        The streaming analogue of the reference's buffered minibatch iterator
+        (``CNTKModel.scala:50-104``) minus the per-element JVM->native copy sin:
+        slices here are contiguous ndarray views handed to jax.device_put whole.
+        """
+        cols = list(cols) if cols is not None else self.schema.names
+        buf: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
+        buffered = 0
+        for p in self.partitions:
+            n = len(p[cols[0]]) if cols else 0
+            off = 0
+            while off < n:
+                take = min(batch_size - buffered, n - off)
+                for c in cols:
+                    buf[c].append(p[c][off:off + take])
+                buffered += take
+                off += take
+                if buffered == batch_size:
+                    yield {c: _cat(buf[c]) for c in cols}
+                    buf = {c: [] for c in cols}
+                    buffered = 0
+        if buffered and not drop_remainder:
+            yield {c: _cat(buf[c]) for c in cols}
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{c.name}:{c.dtype.value}" for c in self.schema)
+        return f"Frame[{cols}] rows={self.count()} partitions={self.num_partitions}"
+
+
+def _cat(arrs: List[np.ndarray]) -> np.ndarray:
+    return arrs[0] if len(arrs) == 1 else np.concatenate(arrs, axis=0)
+
+
+def _empty_column(c: ColumnSchema) -> np.ndarray:
+    if c.dtype == DType.VECTOR:
+        return np.zeros((0, c.dim or 0), dtype=np.float32)
+    return np.zeros(0, dtype=c.dtype.numpy_dtype)

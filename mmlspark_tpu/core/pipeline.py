@@ -1,0 +1,102 @@
+"""Estimator / Transformer / Model / Pipeline contracts.
+
+Same user-facing contract as the reference (it is what the reference's whole
+L4/L5 stack — and its users — are written against), re-hosted on Frame:
+``fit``/``transform`` bodies JIT to XLA where they touch tensors.
+
+Reference: Spark ML's PipelineStage hierarchy as used throughout
+``/root/reference/src`` (e.g. ``TrainClassifier.scala:81``,
+``Featurize.scala:67``); save/load via the serialization layer replaces
+``PipelineUtilities.saveMetadata`` (``utils/src/main/scala/PipelineUtilities.scala:19-47``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from mmlspark_tpu.core.frame import Frame
+from mmlspark_tpu.core.params import AnyParam, Params
+from mmlspark_tpu.core.schema import Schema
+
+
+class PipelineStage(Params):
+    """Anything that can sit in a Pipeline and be saved/loaded."""
+
+    def save(self, path: str) -> None:
+        from mmlspark_tpu.core.serialization import save_stage
+        save_stage(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineStage":
+        from mmlspark_tpu.core.serialization import load_stage
+        stage = load_stage(path)
+        if cls is not PipelineStage and not isinstance(stage, cls):
+            raise TypeError(f"loaded {type(stage).__name__}, expected {cls.__name__}")
+        return stage
+
+    # Learned state hook: dict pytree with ndarray leaves; see serialization.py.
+    def _get_state(self) -> Dict[str, Any]:
+        return getattr(self, "_state", {}) or {}
+
+    def _set_state(self, state: Dict[str, Any]) -> None:
+        if state:
+            self._state = state
+
+
+class Transformer(PipelineStage):
+    def transform(self, frame: Frame) -> Frame:
+        raise NotImplementedError
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        """Best-effort schema-out-of-schema (used by validation & codegen)."""
+        return schema
+
+    def __call__(self, frame: Frame) -> Frame:
+        return self.transform(frame)
+
+
+class Estimator(PipelineStage):
+    def fit(self, frame: Frame) -> "Transformer":
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    """A fitted Transformer (kept as a distinct type for API parity)."""
+
+
+class Pipeline(Estimator):
+    """Sequential composition of stages; estimators are fitted in order and
+    replaced by the models they produce, exactly like Spark's Pipeline."""
+
+    stages = AnyParam("stages", "ordered list of pipeline stages", default=[])
+
+    def fit(self, frame: Frame) -> "PipelineModel":
+        stages = self.get("stages")
+        for i, stage in enumerate(stages):
+            if not isinstance(stage, (Estimator, Transformer)):
+                raise TypeError(f"stage {i} ({type(stage).__name__}) is neither "
+                                "Estimator nor Transformer")
+        # No frame pass is needed beyond the last estimator (Spark semantics).
+        last_est = max((i for i, s in enumerate(stages) if isinstance(s, Estimator)),
+                       default=-1)
+        fitted: List[Transformer] = []
+        cur = frame
+        for i, stage in enumerate(stages):
+            model = stage.fit(cur) if isinstance(stage, Estimator) else stage
+            if i < last_est:
+                cur = model.transform(cur)
+            fitted.append(model)
+        return PipelineModel(stages=fitted)
+
+
+class PipelineModel(Model):
+    stages = AnyParam("stages", "ordered list of fitted transformers", default=[])
+
+    def transform(self, frame: Frame) -> Frame:
+        for stage in self.get("stages"):
+            frame = stage.transform(frame)
+        return frame
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        for stage in self.get("stages"):
+            schema = stage.transform_schema(schema)
+        return schema

@@ -1,0 +1,50 @@
+"""Test env: force CPU with 8 virtual devices BEFORE jax is imported.
+
+This is the TPU-translation of the reference's `local[*]` SparkSession fixture
+(``core/test/base/src/main/scala/TestBase.scala:26-155``): multi-chip behavior
+made testable on one box via a fake device mesh.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The site environment may import jax before conftest runs; the backend is
+# still chosen lazily, so flipping the config here is sufficient as long as
+# no test module touches devices at import time.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+def pytest_sessionstart(session):
+    assert jax.default_backend() == "cpu"
+    assert jax.device_count() == 8, (
+        f"expected 8 virtual CPU devices, got {jax.device_count()}")
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def make_basic_frame():
+    """Tiny inline frame, counterpart of the reference's makeBasicDF
+    (TestBase.scala:126-137)."""
+    from mmlspark_tpu import Frame
+    return Frame.from_dict({
+        "numbers": [0, 1, 2, 3],
+        "words": ["guitars", "drums", "bass", "keys"],
+        "more": ["apples", "oranges", "grapes", "pears"],
+        "values": [1.5, 2.5, 3.5, 4.5],
+    })
+
+
+@pytest.fixture
+def basic_frame():
+    return make_basic_frame()

@@ -1,0 +1,233 @@
+"""Core runtime tests: params, schema metadata, frame ops, pipeline, save/load."""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Frame, Pipeline, PipelineModel, Transformer
+from mmlspark_tpu.core.params import (
+    HasInputCol, HasOutputCol, IntParam, ParamException, Params, StringParam,
+)
+from mmlspark_tpu.core.schema import (
+    CategoricalMap, ColumnSchema, DType, Schema, ScoreKind, SchemaError,
+    find_score_column, set_score_column,
+)
+from mmlspark_tpu.core.serialization import load_stage, register_stage, save_stage
+
+
+# ---------------------------------------------------------------- params
+class Doubler(HasInputCol, HasOutputCol, Transformer):
+    times = IntParam("times", "multiplier", 2, validator=lambda v: v > 0)
+
+    def transform(self, frame):
+        col = ColumnSchema(self.outputCol, frame.schema[self.inputCol].dtype)
+        return frame.with_column(col, lambda p: p[self.inputCol] * self.times)
+
+
+Doubler = register_stage(Doubler)
+
+
+def test_param_defaults_and_set():
+    d = Doubler()
+    assert d.times == 2
+    assert d.inputCol == "input"
+    d.set_params(times=5, inputCol="numbers")
+    assert d.times == 5
+    assert d.is_set("times") and not d.is_set("outputCol")
+
+
+def test_param_validation():
+    with pytest.raises(ParamException):
+        Doubler(times=-1)
+    with pytest.raises(ParamException):
+        Doubler(times="three")
+    with pytest.raises(ParamException):
+        Doubler().get_param("nope")
+
+
+def test_param_domain():
+    class S(Params):
+        mode = StringParam("mode", "a mode", "auto", domain=["auto", "manual"])
+    assert S().mode == "auto"
+    with pytest.raises(ParamException):
+        S(mode="bogus")
+
+
+def test_uid_format():
+    assert Doubler().uid.startswith("Doubler_")
+
+
+# ---------------------------------------------------------------- schema
+def test_categorical_map_roundtrip():
+    cm = CategoricalMap(["low", "mid", "high"], has_null_level=False)
+    assert cm.get_index("mid") == 1
+    assert cm.get_level(2) == "high"
+    assert cm.get_index("missing", default=3) == 3
+    with pytest.raises(SchemaError):
+        cm.get_index("missing")
+    cm2 = CategoricalMap.from_metadata(cm.to_metadata())
+    assert cm2.levels == cm.levels
+
+
+def test_score_column_discovery():
+    schema = Schema([ColumnSchema("label", DType.FLOAT64),
+                     ColumnSchema("pred", DType.FLOAT64)])
+    schema = set_score_column(schema, "pred", "model_1", ScoreKind.SCORED_LABELS,
+                              ScoreKind.CLASSIFICATION)
+    assert find_score_column(schema, ScoreKind.SCORED_LABELS) == "pred"
+    assert find_score_column(schema, ScoreKind.SCORES) is None
+
+
+def test_find_unused_name():
+    schema = Schema([ColumnSchema("x", DType.INT32), ColumnSchema("x_1", DType.INT32)])
+    assert schema.find_unused_name("x") == "x_2"
+    assert schema.find_unused_name("y") == "y"
+
+
+# ---------------------------------------------------------------- frame
+def test_frame_from_dict_infers_types(basic_frame):
+    s = basic_frame.schema
+    assert s["numbers"].dtype == DType.INT64
+    assert s["words"].dtype == DType.STRING
+    assert s["values"].dtype == DType.FLOAT64
+    assert basic_frame.count() == 4
+
+
+def test_frame_select_drop_rename(basic_frame):
+    f = basic_frame.select("numbers", "words")
+    assert f.columns == ["numbers", "words"]
+    assert basic_frame.drop("more").columns == ["numbers", "words", "values"]
+    g = basic_frame.rename({"numbers": "n"})
+    assert "n" in g.columns and "numbers" not in g.columns
+
+
+def test_frame_vector_column():
+    f = Frame.from_dict({"v": np.arange(12, dtype=np.float32).reshape(4, 3)})
+    assert f.schema["v"].dtype == DType.VECTOR
+    assert f.schema["v"].dim == 3
+
+
+def test_frame_repartition_roundtrip(basic_frame):
+    f = basic_frame.repartition(3)
+    assert f.num_partitions == 3
+    assert f.count() == 4
+    np.testing.assert_array_equal(f.column("numbers"), [0, 1, 2, 3])
+    g = f.coalesce(1)
+    assert g.num_partitions == 1
+    np.testing.assert_array_equal(g.column("numbers"), [0, 1, 2, 3])
+
+
+def test_frame_filter_and_na_drop():
+    f = Frame.from_dict({"x": [1.0, float("nan"), 3.0], "s": ["a", "b", None]})
+    assert f.na_drop(["x"]).count() == 2
+    assert f.na_drop().count() == 1
+    g = f.filter(lambda p: p["x"] > 1)  # NaN > 1 is False
+    np.testing.assert_array_equal(g.column("x"), [3.0])
+
+
+def test_frame_batches_cross_partition():
+    f = Frame.from_dict({"x": np.arange(10)}).repartition(3)
+    batches = list(f.batches(4))
+    sizes = [len(b["x"]) for b in batches]
+    assert sizes == [4, 4, 2]
+    np.testing.assert_array_equal(np.concatenate([b["x"] for b in batches]),
+                                  np.arange(10))
+    assert [len(b["x"]) for b in f.batches(4, drop_remainder=True)] == [4, 4]
+
+
+def test_frame_distinct_union(basic_frame):
+    f = basic_frame.union(basic_frame)
+    assert f.count() == 8
+    assert sorted(f.distinct_values("numbers")) == [0, 1, 2, 3]
+
+
+def test_numeric_column_with_none_becomes_float_nan():
+    f = Frame.from_dict({"x": [1.0, None, 3.0], "i": [1, None, 3]})
+    assert f.schema["x"].dtype == DType.FLOAT64
+    assert f.schema["i"].dtype == DType.FLOAT64
+    assert np.isnan(f.column("x")[1])
+    assert f.na_drop(["x"]).count() == 2
+    # post-drop the column is a real float array, streamable to device
+    assert f.na_drop(["x"]).column("x").dtype == np.float64
+
+
+def test_concat_validates():
+    f = Frame.from_dict({"a": [1]})
+    with pytest.raises(SchemaError):
+        Frame.concat([])
+    with pytest.raises(SchemaError):
+        Frame.concat([f, Frame.from_dict({"b": [1]})])
+    assert Frame.concat([f, f]).count() == 2
+
+
+def test_param_accepts_numpy_scalars():
+    d = Doubler()
+    d.set("times", np.int64(5))
+    assert d.times == 5 and type(d.times) is int
+
+
+def test_state_nonstring_dict_keys_roundtrip(tmp_path):
+    d = Doubler()
+    d._state = {"map": {0: "zero", 1: "one"}, "t": (1, 2)}
+    save_stage(d, str(tmp_path / "s"))
+    d2 = load_stage(str(tmp_path / "s"))
+    assert d2._state["map"] == {0: "zero", 1: "one"}
+    assert d2._state["t"] == (1, 2)
+
+
+def test_pipeline_fit_skips_transforms_after_last_estimator(basic_frame):
+    calls = []
+
+    class Probe(Doubler):
+        def transform(self, frame):
+            calls.append(self.uid)
+            return super().transform(frame)
+
+    p1 = Probe(inputCol="numbers", outputCol="a")
+    p2 = Probe(inputCol="a", outputCol="b")
+    Pipeline(stages=[p1, p2]).fit(basic_frame)
+    assert calls == []  # all-transformer pipeline: fit touches nothing
+
+
+def test_frame_with_column_values():
+    f = Frame.from_dict({"x": np.arange(6)}).repartition(2)
+    g = f.with_column_values(ColumnSchema("y", DType.FLOAT32), np.ones(6))
+    assert g.num_partitions == 2
+    np.testing.assert_array_equal(g.column("y"), np.ones(6))
+    with pytest.raises(SchemaError):
+        f.with_column_values(ColumnSchema("y", DType.FLOAT32), np.ones(5))
+
+
+# ---------------------------------------------------------------- pipeline
+def test_pipeline_fit_transform(basic_frame):
+    pipe = Pipeline(stages=[
+        Doubler(inputCol="numbers", outputCol="d1"),
+        Doubler(inputCol="d1", outputCol="d2", times=3),
+    ])
+    model = pipe.fit(basic_frame)
+    assert isinstance(model, PipelineModel)
+    out = model.transform(basic_frame)
+    np.testing.assert_array_equal(out.column("d2"), np.array([0, 6, 12, 18]))
+
+
+# ---------------------------------------------------------------- save/load
+def test_stage_save_load_roundtrip(tmp_path, basic_frame):
+    d = Doubler(inputCol="numbers", outputCol="out", times=7)
+    d._state = {"weights": np.arange(3, dtype=np.float32), "meta": {"k": 1},
+                "blob": b"\x00\x01"}
+    path = str(tmp_path / "doubler")
+    save_stage(d, path)
+    d2 = load_stage(path)
+    assert isinstance(d2, Doubler)
+    assert d2.uid == d.uid and d2.times == 7
+    np.testing.assert_array_equal(d2._state["weights"], d._state["weights"])
+    assert d2._state["meta"] == {"k": 1} and d2._state["blob"] == b"\x00\x01"
+    np.testing.assert_array_equal(d2.transform(basic_frame).column("out"),
+                                  d.transform(basic_frame).column("out"))
+
+
+def test_pipeline_save_load_nested(tmp_path, basic_frame):
+    model = Pipeline(stages=[Doubler(inputCol="numbers", outputCol="d1")]).fit(basic_frame)
+    path = str(tmp_path / "pipe")
+    model.save(path)
+    m2 = PipelineModel.load(path)
+    np.testing.assert_array_equal(m2.transform(basic_frame).column("d1"),
+                                  model.transform(basic_frame).column("d1"))
