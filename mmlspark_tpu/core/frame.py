@@ -69,8 +69,10 @@ def _normalize(values: Any, dtype: Optional[DType] = None) -> Tuple[np.ndarray, 
         if lst and isinstance(lst[0], np.ndarray) and dtype in (None, DType.VECTOR):
             arr = np.stack([np.asarray(v, dtype=np.float32) for v in lst])
         else:
-            numeric = bool(lst) and all(
-                v is None or isinstance(v, (int, float, bool, np.number)) for v in lst)
+            numeric = (bool(lst)
+                       and any(v is not None for v in lst)
+                       and all(v is None or isinstance(v, (int, float, bool, np.number))
+                               for v in lst))
             has_none = any(v is None for v in lst)
             try:
                 if dtype is not None and dtype.is_numeric:
@@ -219,9 +221,11 @@ class Frame:
         schema = self.schema.add(col)
         parts = []
         for p in self.partitions:
-            arr, _, dim = _normalize(fn(p), col.dtype)
+            arr, actual, dim = _normalize(fn(p), col.dtype)
             if col.dtype == DType.VECTOR and col.dim is None and dim is not None:
                 schema = schema.add(ColumnSchema(col.name, col.dtype, dim, col.metadata))
+            elif actual != col.dtype:  # e.g. int requested but NaN forced float64
+                schema = schema.add(ColumnSchema(col.name, actual, dim, col.metadata))
             q = dict(p)
             q[col.name] = arr
             parts.append(q)
@@ -229,9 +233,11 @@ class Frame:
 
     def with_column_values(self, col: ColumnSchema, values: Any) -> "Frame":
         """Add/replace a column from a full-length array, split across partitions."""
-        arr, _, dim = _normalize(values, col.dtype)
+        arr, actual, dim = _normalize(values, col.dtype)
         if col.dtype == DType.VECTOR and col.dim is None and dim is not None:
             col = ColumnSchema(col.name, col.dtype, dim, col.metadata)
+        elif actual != col.dtype:
+            col = ColumnSchema(col.name, actual, dim, col.metadata)
         if len(arr) != self.count():
             raise SchemaError(f"column length {len(arr)} != frame length {self.count()}")
         schema = self.schema.add(col)

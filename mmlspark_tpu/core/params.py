@@ -199,6 +199,8 @@ class Params:
     def copy(self) -> "Params":
         other = copy.copy(self)
         other._paramMap = dict(self._paramMap)
+        if hasattr(self, "_state"):
+            other._state = copy.deepcopy(self._state)
         return other
 
     def explain_params(self) -> str:

@@ -55,6 +55,10 @@ def _resolve_class(qualname: str) -> Type:
 # -- pytree <-> (json, arrays) codec ----------------------------------------
 def _encode(obj: Any, arrays: Dict[str, np.ndarray], path: str) -> Any:
     if isinstance(obj, np.ndarray):
+        if obj.dtype == np.object_:
+            raise TypeError(
+                f"object ndarray at state path {path!r} cannot be serialized "
+                "safely; convert to a list or a typed array first")
         arrays[path] = obj
         return {"__nd__": path}
     if isinstance(obj, (np.integer,)):

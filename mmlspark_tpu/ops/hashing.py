@@ -1,0 +1,97 @@
+"""Murmur3-based term hashing, bit-compatible with Spark ML's HashingTF.
+
+The reference pins exact hash slot indices in 2^18-dim space
+(``core/ml/src/test/scala/HashingTFSpec.scala:22-29``), so the featurizer's
+hash function must reproduce Spark's ``Murmur3_x86_32.hashUnsafeBytes`` over
+UTF-8 bytes with seed 42, including its quirk of mixing each *trailing* byte
+(signed!) as its own 4-byte word, followed by ``Utils.nonNegativeMod``.
+
+Hashing is per-term Python with a large LRU cache, so repeated vocabulary
+(the common case in tabular/text featurization) hashes at dict-lookup speed;
+a C fast path for cold, huge vocabularies belongs to the native runtime layer.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Iterable, List, Sequence
+
+import numpy as np
+
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+_MASK = 0xFFFFFFFF
+SPARK_SEED = 42
+
+
+def _rotl(x: int, n: int) -> int:
+    return ((x << n) | (x >> (32 - n))) & _MASK
+
+
+def _mix_k1(k1: int) -> int:
+    k1 = (k1 * _C1) & _MASK
+    k1 = _rotl(k1, 15)
+    return (k1 * _C2) & _MASK
+
+
+def _mix_h1(h1: int, k1: int) -> int:
+    h1 ^= k1
+    h1 = _rotl(h1, 13)
+    return (h1 * 5 + 0xE6546B64) & _MASK
+
+
+def murmur3_x86_32(data: bytes, seed: int = SPARK_SEED) -> int:
+    """Spark-compatible murmur3 over bytes; returns a SIGNED 32-bit int."""
+    h1 = seed & _MASK
+    n_aligned = len(data) - len(data) % 4
+    for i in range(0, n_aligned, 4):
+        k1 = int.from_bytes(data[i:i + 4], "little")
+        h1 = _mix_h1(h1, _mix_k1(k1))
+    # Spark tail quirk: each remaining byte is sign-extended and mixed alone.
+    for i in range(n_aligned, len(data)):
+        b = data[i]
+        half_word = b - 256 if b >= 128 else b
+        h1 = _mix_h1(h1, _mix_k1(half_word & _MASK))
+    h1 ^= len(data)
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & _MASK
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & _MASK
+    h1 ^= h1 >> 16
+    return h1 - (1 << 32) if h1 >= (1 << 31) else h1
+
+
+@lru_cache(maxsize=1 << 20)
+def _term_hash(term: str) -> int:
+    return murmur3_x86_32(term.encode("utf-8"))
+
+
+def hash_term(term: str, num_features: int) -> int:
+    """Slot index for one term: nonNegativeMod(murmur3(term), numFeatures)."""
+    if num_features <= 0:
+        raise ValueError(f"num_features must be positive, got {num_features}")
+    return _term_hash(term) % num_features
+
+
+def hash_terms(terms: Iterable[str], num_features: int) -> np.ndarray:
+    """Slot indices (int64) for a sequence of terms."""
+    if num_features <= 0:
+        raise ValueError(f"num_features must be positive, got {num_features}")
+    return np.fromiter((_term_hash(t) % num_features for t in terms),
+                       dtype=np.int64)
+
+
+def term_frequencies(token_rows: Sequence[Sequence[str]],
+                     num_features: int) -> List[np.ndarray]:
+    """Per-row (slots, counts) pairs — the HashingTF transform per row.
+
+    Returns a list of (k, 2) arrays [slot, count] sorted by slot, mirroring
+    Spark's SparseVector ordering so downstream slot selection is stable.
+    """
+    out = []
+    for tokens in token_rows:
+        if tokens is None:
+            raise ValueError("HashingTF applied to a null token array")
+        slots = hash_terms(tokens, num_features)
+        uniq, counts = np.unique(slots, return_counts=True)
+        out.append(np.stack([uniq, counts.astype(np.int64)], axis=1))
+    return out

@@ -1,0 +1,188 @@
+"""Featurize-path tests: hashing parity, ValueIndexer semantics, AssembleFeatures."""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Frame
+from mmlspark_tpu.core.schema import DType, SchemaError
+from mmlspark_tpu.core.serialization import load_stage, save_stage
+from mmlspark_tpu.feature.featurize import AssembleFeatures, Featurize, tokenize
+from mmlspark_tpu.feature.value_indexer import IndexToValue, ValueIndexer
+from mmlspark_tpu.ops.hashing import hash_term, term_frequencies
+
+
+# -- murmur3 parity with Spark HashingTF (reference HashingTFSpec.scala) -----
+def test_hashing_parity_pinned_indices():
+    # exact slot indices pinned by the reference in 2^18-dim space
+    expected = {"Hi": 242088, "I": 113890, "can": 36073, "not": 139098,
+                "foo": 51654, "Logistic": 142455, "regression": 13671,
+                "Log": 74466, "f": 24152, "reg": 122984}
+    for term, slot in expected.items():
+        assert hash_term(term, 262144) == slot, term
+    assert hash_term("", 262144) == 249180  # empty string is a word
+
+
+def test_hashing_parity_other_sizes():
+    words = ["Hi", "I", "can", "not", "foo", "bar", "foo", "afk"]
+    tf = term_frequencies([words], 100000)[0]
+    assert tf[:, 0].tolist() == [5833, 9467, 16680, 29018, 68900, 85762, 97510]
+    tf1 = term_frequencies([words], 1)[0]
+    assert tf1.tolist() == [[0, 8]]
+
+
+def test_hashing_null_raises():
+    with pytest.raises(ValueError):
+        term_frequencies([["a"], None], 100)
+    with pytest.raises(ValueError):
+        hash_term("x", 0)
+
+
+# -- ValueIndexer (reference ValueIndexer.scala:67-169) ----------------------
+def test_value_indexer_string():
+    f = Frame.from_dict({"s": ["b", "a", "c", "a"]})
+    m = ValueIndexer(inputCol="s", outputCol="si").fit(f)
+    out = m.transform(f)
+    np.testing.assert_array_equal(out.column("si"), [1, 0, 2, 0])  # sorted levels
+    assert out.schema["si"].categorical.levels == ["a", "b", "c"]
+
+
+def test_value_indexer_null_and_unseen():
+    f = Frame.from_dict({"s": ["b", "a", None]})
+    m = ValueIndexer(inputCol="s", outputCol="si").fit(f)
+    out = m.transform(f)
+    # null -> num_levels (=2); levels are [a, b]
+    np.testing.assert_array_equal(out.column("si"), [1, 0, 2])
+    assert out.schema["si"].categorical.has_null_level
+    # unseen on a model fitted WITHOUT nulls -> num_levels
+    f2 = Frame.from_dict({"s": ["a", "b"]})
+    m2 = ValueIndexer(inputCol="s", outputCol="si").fit(f2)
+    out2 = m2.transform(Frame.from_dict({"s": ["zz", "a"]}))
+    np.testing.assert_array_equal(out2.column("si"), [2, 0])
+
+
+def test_value_indexer_numeric_and_roundtrip(tmp_path):
+    f = Frame.from_dict({"x": [30, 10, 20, 10]})
+    m = ValueIndexer(inputCol="x", outputCol="xi").fit(f)
+    out = m.transform(f)
+    np.testing.assert_array_equal(out.column("xi"), [2, 0, 1, 0])
+    save_stage(m, str(tmp_path / "vi"))
+    m2 = load_stage(str(tmp_path / "vi"))
+    np.testing.assert_array_equal(m2.transform(f).column("xi"), [2, 0, 1, 0])
+
+
+def test_index_to_value_inverse():
+    f = Frame.from_dict({"s": ["b", "a", "c"]})
+    m = ValueIndexer(inputCol="s", outputCol="si").fit(f)
+    out = IndexToValue(inputCol="si", outputCol="s2").transform(m.transform(f))
+    assert out.column("s2").tolist() == ["b", "a", "c"]
+
+
+def test_index_to_value_requires_metadata():
+    f = Frame.from_dict({"i": [0, 1]})
+    with pytest.raises(SchemaError):
+        IndexToValue(inputCol="i", outputCol="o").transform(f)
+
+
+# -- AssembleFeatures --------------------------------------------------------
+def test_tokenize_spark_semantics():
+    assert tokenize("Hey You  no way") == ["hey", "you", "no", "way"]
+    assert tokenize(None) == []
+
+
+def make_mixed_frame():
+    return Frame.from_dict({
+        "age": [25.0, 40.0, 31.0],
+        "n": [1, 2, 3],
+        "text": ["foo bar", "foo", "baz foo"],
+        "vec": np.arange(6, dtype=np.float32).reshape(3, 2),
+    }, num_partitions=2)
+
+
+def test_assemble_features_layout_and_values():
+    f = make_mixed_frame()
+    model = AssembleFeatures(
+        featuresCol="features",
+        columnsToFeaturize=["age", "n", "text", "vec"]).fit(f)
+    out = model.transform(f)
+    col = out.schema["features"]
+    assert col.dtype == DType.VECTOR
+    X = out.column("features")
+    # layout: numerics (age, n) | vec (2) | hashed slots (foo, bar, baz = 3)
+    assert X.shape == (3, 2 + 2 + 3)
+    np.testing.assert_array_equal(X[:, 0], [25, 40, 31])
+    np.testing.assert_array_equal(X[:, 1], [1, 2, 3])
+    np.testing.assert_array_equal(X[:, 2:4], [[0, 1], [2, 3], [4, 5]])
+    # hashed part: every row contains "foo" exactly once
+    hashed = X[:, 4:]
+    assert (hashed.sum(axis=1) == [2, 1, 2]).all()
+    # same token always lands in the same slot column
+    foo_cols = (hashed > 0).sum(axis=0)
+    assert foo_cols.max() == 3  # "foo" active in all three rows
+
+
+def test_assemble_features_categorical_first():
+    f = Frame.from_dict({"x": [1.0, 2.0], "c": ["u", "v"]})
+    f = ValueIndexer(inputCol="c", outputCol="ci").fit(f).transform(f)
+    f = f.drop("c")
+    model = AssembleFeatures(featuresCol="feats",
+                             columnsToFeaturize=["x", "ci"]).fit(f)
+    out = model.transform(f)
+    X = out.column("feats")
+    # one-hot of ci comes FIRST (FastVectorAssembler contract), then x
+    np.testing.assert_array_equal(X, [[1, 0, 1], [0, 1, 2]])
+    layout = out.schema["feats"].metadata["feature_layout"]
+    assert layout[0][3] == "onehot" and layout[0][0] == "ci"
+
+
+def test_assemble_features_nan_cleaning():
+    f = Frame.from_dict({"x": [1.0, float("nan"), 3.0]})
+    model = AssembleFeatures(featuresCol="feats", columnsToFeaturize=["x"]).fit(f)
+    out = model.transform(f)
+    assert out.count() == 2  # NaN row dropped (reference colNamesToCleanMissings)
+
+
+def test_featurize_multi_output(tmp_path):
+    f = make_mixed_frame()
+    fz = Featurize(featureColumns={"f1": ["age", "n"], "f2": ["text"]},
+                   numberOfFeatures=4096)
+    model = fz.fit(f)
+    out = model.transform(f)
+    assert out.schema["f1"].dim == 2
+    assert out.schema["f2"].dim >= 2
+    # save/load round trip preserves output
+    save_stage(model, str(tmp_path / "fz"))
+    m2 = load_stage(str(tmp_path / "fz"))
+    np.testing.assert_array_equal(m2.transform(f).column("f1"),
+                                  out.column("f1"))
+
+
+def test_all_none_column_stays_string():
+    f = Frame.from_dict({"text": [None, None]})
+    assert f.schema["text"].dtype == DType.STRING
+
+
+def test_slot_scan_skips_nan_dropped_rows():
+    f = Frame.from_dict({"x": [1.0, float("nan")],
+                         "text": ["keepme", "droptoken"]})
+    model = AssembleFeatures(featuresCol="feats",
+                             columnsToFeaturize=["x", "text"]).fit(f)
+    out = model.transform(f)
+    X = out.column("feats")
+    assert X.shape == (1, 2)  # 1 numeric + 1 slot: droptoken's slot never made
+
+
+def test_model_copy_does_not_share_state():
+    f = Frame.from_dict({"s": ["a", "b"]})
+    m = ValueIndexer(inputCol="s", outputCol="si").fit(f)
+    m2 = m.copy()
+    m2._state["levels"].append("zzz")
+    assert m._state["levels"] == ["a", "b"]
+
+
+def test_assemble_unseen_tokens_ignored_at_transform():
+    f = Frame.from_dict({"text": ["alpha beta", "beta"]})
+    model = AssembleFeatures(featuresCol="feats",
+                             columnsToFeaturize=["text"]).fit(f)
+    out = model.transform(Frame.from_dict({"text": ["alpha GAMMA_unseen"]}))
+    X = out.column("feats")
+    assert X.shape[1] == 2      # only alpha/beta slots exist
+    assert X.sum() == 1.0       # unseen token contributes nothing
