@@ -201,6 +201,7 @@ class Params:
         other._paramMap = dict(self._paramMap)
         if hasattr(self, "_state"):
             other._state = copy.deepcopy(self._state)
+        other._jit_cache = None  # never share compiled closures with the copy
         return other
 
     def explain_params(self) -> str:

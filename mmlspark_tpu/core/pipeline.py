@@ -38,8 +38,19 @@ class PipelineStage(Params):
         return getattr(self, "_state", {}) or {}
 
     def _set_state(self, state: Dict[str, Any]) -> None:
+        self._jit_cache = None  # compiled closures are stale once state changes
         if state:
             self._state = state
+
+    def _cached_jit(self, builder):
+        """Memoize a jitted closure over this stage's state: the first jit
+        compile on TPU is 20-40s, so repeat transform() calls must not pay it
+        again. Invalidated by _set_state and copy()."""
+        fn = getattr(self, "_jit_cache", None)
+        if fn is None:
+            fn = builder()
+            self._jit_cache = fn
+        return fn
 
 
 class Transformer(PipelineStage):

@@ -1,0 +1,225 @@
+"""ComputeModelStatistics: evaluator-as-transformer with metadata discovery.
+
+Re-expression of
+``compute-model-statistics/src/main/scala/ComputeModelStatistics.scala:86-559``:
+discovers which columns are labels/scores/probabilities from column metadata
+stamped by TrainedClassifierModel (``getSchemaInfo`` ``:205-218``), then:
+
+- classification: confusion matrix, accuracy/precision/recall (binary
+  ``:449-459``; multiclass micro/macro per Sokolova–Lapalme ``:375-429``),
+  AUC + ROC curve retained as the ``roc_curve`` attribute (``:431-447``);
+- regression: mse/rmse/r2/mae (``:181-199``).
+
+Metric names match the reference's Spark-metric spellings
+(``ComputeModelStatistics.scala:26-59``). The observable API is the same:
+metrics are *returned as a Frame*.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.frame import Frame
+from mmlspark_tpu.core.params import Params, StringParam
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.core.schema import (
+    ScoreKind, find_score_column, find_score_value_kind,
+)
+from mmlspark_tpu.core.serialization import register_stage
+
+# Spark-metric spellings (reference :26-37)
+MSE, RMSE, R2, MAE = "mse", "rmse", "r2", "mae"
+AUC, ACCURACY, PRECISION, RECALL = "AUC", "accuracy", "precision", "recall"
+ALL_METRICS = "all"
+CLASSIFICATION_METRICS = [ACCURACY, PRECISION, RECALL, AUC]
+REGRESSION_METRICS = [MSE, RMSE, R2, MAE]
+
+
+def map_labels_to_indices(arr: np.ndarray, cmap) -> np.ndarray:
+    """Map raw label values (string OR numeric) to level indices; values
+    outside the map get index ``num_levels`` (the unseen slot)."""
+    return np.asarray(
+        [cmap.get_index(v.item() if isinstance(v, np.generic) else v,
+                        default=cmap.num_levels) for v in arr],
+        dtype=np.int64)
+
+
+def roc_curve(labels: np.ndarray, scores: np.ndarray) -> np.ndarray:
+    """Binary ROC curve points (fpr, tpr) sorted by descending threshold."""
+    order = np.argsort(-scores, kind="stable")
+    labels = labels[order]
+    tps = np.cumsum(labels)
+    fps = np.cumsum(1 - labels)
+    P = max(tps[-1] if len(tps) else 0, 1)
+    N = max(fps[-1] if len(fps) else 0, 1)
+    # keep last point per distinct score to get the staircase vertices
+    distinct = np.r_[np.nonzero(np.diff(scores[order]))[0], len(labels) - 1] \
+        if len(labels) else np.array([], dtype=int)
+    fpr = np.r_[0.0, fps[distinct] / N]
+    tpr = np.r_[0.0, tps[distinct] / P]
+    return np.stack([fpr, tpr], axis=1)
+
+
+def auc_from_roc(curve: np.ndarray) -> float:
+    return float(np.trapezoid(curve[:, 1], curve[:, 0]))
+
+
+def confusion_matrix(y: np.ndarray, pred: np.ndarray, k: int) -> np.ndarray:
+    cm = np.zeros((k, k), dtype=np.int64)
+    np.add.at(cm, (y.astype(int), pred.astype(int)), 1)
+    return cm
+
+
+def binary_accuracy_precision_recall(cm: np.ndarray) -> Tuple[float, float, float]:
+    """Reference getBinaryAccuracyPrecisionRecall (:449-459); positive class=1."""
+    tn, fp, fn, tp = cm[0, 0], cm[0, 1], cm[1, 0], cm[1, 1]
+    total = cm.sum()
+    acc = (tp + tn) / total if total else 0.0
+    prec = tp / (tp + fp) if (tp + fp) else 0.0
+    rec = tp / (tp + fn) if (tp + fn) else 0.0
+    return float(acc), float(prec), float(rec)
+
+
+def multiclass_metrics(cm: np.ndarray) -> Dict[str, float]:
+    """Micro/macro averaged metrics per Sokolova–Lapalme (reference :375-429)."""
+    k = cm.shape[0]
+    total = cm.sum()
+    tp = np.diag(cm).astype(np.float64)
+    fp = cm.sum(axis=0) - tp
+    fn = cm.sum(axis=1) - tp
+    tn = total - tp - fp - fn
+    with np.errstate(divide="ignore", invalid="ignore"):
+        per_prec = np.where(tp + fp > 0, tp / (tp + fp), 0.0)
+        per_rec = np.where(tp + fn > 0, tp / (tp + fn), 0.0)
+    micro = float(tp.sum() / total) if total else 0.0
+    return {
+        "average_accuracy": float(((tp + tn) / total).mean()) if total else 0.0,
+        "macro_averaged_precision": float(per_prec.mean()),
+        "macro_averaged_recall": float(per_rec.mean()),
+        "micro_averaged_precision": micro,
+        "micro_averaged_recall": micro,
+        ACCURACY: micro,
+    }
+
+
+@register_stage
+class ComputeModelStatistics(Transformer):
+    evaluationMetric = StringParam(
+        "evaluationMetric", "metric to evaluate models with", ALL_METRICS)
+    labelCol = StringParam("labelCol", "label column override", "")
+    scoresCol = StringParam("scoresCol", "scores column override", "")
+    scoredLabelsCol = StringParam("scoredLabelsCol",
+                                  "scored labels column override", "")
+
+    def __init__(self, uid=None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self.roc_curve: Optional[np.ndarray] = None
+        self.confusion_matrix: Optional[np.ndarray] = None
+
+    def _post_load(self):
+        self.roc_curve = None
+        self.confusion_matrix = None
+
+    def _discover(self, frame: Frame) -> Tuple[str, Optional[str], Optional[str], str]:
+        """(label, scored_labels, scores/probabilities, kind) from metadata
+        (reference getSchemaInfo :205-218)."""
+        schema = frame.schema
+        label = self.labelCol or find_score_column(schema, ScoreKind.TRUE_LABELS) \
+            or ("label" if "label" in schema else None)
+        if label is None:
+            raise ValueError("cannot discover label column: no TRUE_LABELS "
+                             "metadata and no labelCol override")
+        kind = find_score_value_kind(schema) or ScoreKind.CLASSIFICATION
+        scored_labels = self.scoredLabelsCol or find_score_column(
+            schema, ScoreKind.SCORED_LABELS)
+        scores = self.scoresCol or find_score_column(
+            schema, ScoreKind.SCORED_PROBABILITIES) or find_score_column(
+            schema, ScoreKind.SCORES)
+        return label, scored_labels, scores, kind
+
+    def transform(self, frame: Frame) -> Frame:
+        self.roc_curve = None          # reset per-call so reuse never reads
+        self.confusion_matrix = None   # a previous dataset's artifacts
+        label, scored_labels, scores, kind = self._discover(frame)
+        if kind == ScoreKind.REGRESSION:
+            return self._regression(frame, label, scores)
+        return self._classification(frame, label, scored_labels, scores)
+
+    # evaluators are pass-through in schema terms; they RETURN a new frame
+    def _regression(self, frame: Frame, label: str, scores: Optional[str]) -> Frame:
+        if scores is None:
+            raise ValueError("no scores column found for regression metrics")
+        y = np.asarray(frame.column(label), dtype=np.float64)
+        pred = np.asarray(frame.column(scores), dtype=np.float64)
+        err = pred - y
+        mse = float((err ** 2).mean()) if len(y) else 0.0
+        ss_tot = float(((y - y.mean()) ** 2).sum()) if len(y) else 0.0
+        metrics = {
+            MSE: mse,
+            RMSE: float(np.sqrt(mse)),
+            R2: 1.0 - float((err ** 2).sum()) / ss_tot if ss_tot else 0.0,
+            MAE: float(np.abs(err).mean()) if len(y) else 0.0,
+        }
+        return self._metrics_frame(metrics, REGRESSION_METRICS)
+
+    def _classification(self, frame: Frame, label: str,
+                        scored_labels: Optional[str],
+                        scores: Optional[str]) -> Frame:
+        if scored_labels is None:
+            raise ValueError("no scored-labels column found for classification")
+        y = self._label_indices(frame, label, scored_labels)
+        pred = np.asarray(frame.column(scored_labels),
+                          dtype=np.float64).astype(np.int64)
+        k = int(max(y.max(initial=0), pred.max(initial=0))) + 1
+        cm = confusion_matrix(y, pred, k)
+        self.confusion_matrix = cm
+
+        metrics: Dict[str, float] = {}
+        if k == 2:
+            acc, prec, rec = binary_accuracy_precision_recall(cm)
+            metrics.update({ACCURACY: acc, PRECISION: prec, RECALL: rec})
+            if scores is not None:
+                sc = np.asarray(frame.column(scores))
+                pos = sc[:, 1] if sc.ndim == 2 and sc.shape[1] >= 2 else sc.ravel()
+                curve = roc_curve(y, pos.astype(np.float64))
+                self.roc_curve = curve
+                metrics[AUC] = auc_from_roc(curve)
+        else:
+            mc = multiclass_metrics(cm)
+            metrics.update(mc)
+            metrics[PRECISION] = mc["micro_averaged_precision"]
+            metrics[RECALL] = mc["micro_averaged_recall"]
+        return self._metrics_frame(metrics, CLASSIFICATION_METRICS)
+
+    def _label_indices(self, frame: Frame, label: str,
+                       scored_labels: str) -> np.ndarray:
+        """Raw labels -> class indices, via the level map the trained model
+        stamped on the label/scored-labels columns (TrainedClassifierModel).
+
+        The map applies to NUMERIC labels too: levels [3, 5, 7] index to
+        0..2, and scored_labels are indices — comparing raw values against
+        indices would produce garbage metrics."""
+        arr = frame.column(label)
+        cmap = frame.schema[label].categorical \
+            or frame.schema[scored_labels].categorical
+        if cmap is None:
+            if arr.dtype == np.object_:
+                raise ValueError(
+                    f"label column {label!r} holds strings but no categorical "
+                    "level metadata is attached to map them to indices")
+            return np.asarray(arr, dtype=np.float64).astype(np.int64)
+        return map_labels_to_indices(arr, cmap)
+
+    def _metrics_frame(self, metrics: Dict[str, float], order: List[str]) -> Frame:
+        want = self.evaluationMetric
+        if want != ALL_METRICS:
+            if want not in metrics:
+                raise ValueError(f"metric {want!r} unavailable; have "
+                                 f"{sorted(metrics)}")
+            return Frame.from_dict({want: [metrics[want]]})
+        ordered = {m: [metrics[m]] for m in order if m in metrics}
+        for m, v in metrics.items():
+            if m not in ordered:
+                ordered[m] = [v]
+        return Frame.from_dict(ordered)

@@ -1,0 +1,68 @@
+"""Per-instance statistics + FindBestModel tests."""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Frame
+from mmlspark_tpu.evaluate.compute_per_instance_statistics import (
+    EPSILON, ComputePerInstanceStatistics,
+)
+from mmlspark_tpu.evaluate.find_best_model import BestModel, FindBestModel
+from mmlspark_tpu.train.learners import LogisticRegression, MLPClassifier
+from mmlspark_tpu.train.train_classifier import TrainClassifier, TrainRegressor
+from mmlspark_tpu.train.learners import LinearRegression
+from tests.test_train import make_census_like
+
+
+def test_per_instance_classification_log_loss():
+    frame = make_census_like(n=100)
+    model = TrainClassifier(model=LogisticRegression(maxIter=50),
+                            labelCol="income").fit(frame)
+    out = ComputePerInstanceStatistics().transform(model.transform(frame))
+    ll = out.column("log_loss")
+    assert ll.shape == (100,)
+    assert (ll >= 0).all()
+    assert ll.max() <= -np.log(EPSILON) + 1e-9
+    # confident correct predictions ~ small loss
+    assert np.median(ll) < 0.7
+
+
+def test_per_instance_regression_losses():
+    rng = np.random.default_rng(5)
+    x = rng.normal(0, 1, 50)
+    y = 2 * x + 1
+    frame = Frame.from_dict({"x": x, "y": y})
+    model = TrainRegressor(model=LinearRegression(), labelCol="y").fit(frame)
+    out = ComputePerInstanceStatistics().transform(model.transform(frame))
+    l1, l2 = out.column("L1_loss"), out.column("L2_loss")
+    np.testing.assert_allclose(l2, l1 ** 2, rtol=1e-5)
+    assert l1.max() < 0.01
+
+
+def test_find_best_model_ranks():
+    frame = make_census_like(n=150)
+    good = TrainClassifier(model=LogisticRegression(maxIter=150),
+                           labelCol="income").fit(frame)
+    bad = TrainClassifier(model=LogisticRegression(maxIter=1, learningRate=1e-6),
+                          labelCol="income").fit(frame)
+    fbm = FindBestModel(models=[bad, good], evaluationMetric="AUC").fit(frame)
+    assert fbm.get("bestModel").uid == good.uid
+    assert fbm._state["best_metric"] > 0.8
+    table = fbm.all_model_metrics
+    assert table.count() == 2
+    assert "AUC" in table.columns and "model_uid" in table.columns
+    assert fbm.roc_curve is not None
+    # BestModel transforms like the winner
+    out = fbm.transform(frame)
+    assert "scored_labels" in out.columns
+
+
+def test_find_best_model_validation():
+    frame = make_census_like(n=60)
+    with pytest.raises(ValueError):
+        FindBestModel(models=[], evaluationMetric="AUC").fit(frame)
+    m = TrainClassifier(model=LogisticRegression(maxIter=5),
+                        labelCol="income").fit(frame)
+    with pytest.raises(ValueError):
+        FindBestModel(models=[m], evaluationMetric="bogus").fit(frame)
+    with pytest.raises(ValueError):
+        FindBestModel(models=[m], evaluationMetric="all").fit(frame)

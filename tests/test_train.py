@@ -1,0 +1,215 @@
+"""End-to-end train/evaluate tests — the notebook-101 equivalent flow.
+
+Reference test model: VerifyTrainClassifier trains learners on canned data
+and checks metrics against a golden file (benchmarkMetrics.csv); here we
+assert quality floors on deterministic synthetic data.
+"""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Frame, PipelineModel
+from mmlspark_tpu.core.schema import ScoreKind, find_score_column
+from mmlspark_tpu.core.serialization import load_stage, save_stage
+from mmlspark_tpu.evaluate.compute_model_statistics import (
+    ComputeModelStatistics, auc_from_roc, confusion_matrix, multiclass_metrics,
+    roc_curve,
+)
+from mmlspark_tpu.train.learners import (
+    LinearRegression, LogisticRegression, MLPClassifier, MLPRegressor, NaiveBayes,
+)
+from mmlspark_tpu.train.train_classifier import (
+    TrainClassifier, TrainRegressor,
+)
+
+
+def make_census_like(n=400, seed=0):
+    """Adult-census-like: numeric + categorical + text, separable-ish label."""
+    rng = np.random.default_rng(seed)
+    age = rng.uniform(18, 70, n)
+    hours = rng.uniform(10, 60, n)
+    edu = rng.choice(["hs", "college", "phd"], n)
+    edu_boost = np.select([edu == "hs", edu == "college", edu == "phd"],
+                          [0.0, 8.0, 16.0])
+    words = rng.choice(["manager", "clerk", "engineer", "cook"], n)
+    word_boost = np.where(words == "manager", 10.0, 0.0)
+    score = age * 0.3 + hours * 0.5 + edu_boost + word_boost + rng.normal(0, 3, n)
+    label = np.where(score > np.median(score), ">50K", "<=50K")
+    return Frame.from_dict({
+        "age": age, "hours": hours, "education": edu.tolist(),
+        "occupation": words.tolist(), "income": label.tolist(),
+    }, num_partitions=3)
+
+
+def test_train_classifier_e2e_logreg():
+    frame = make_census_like()
+    model = TrainClassifier(model=LogisticRegression(), labelCol="income").fit(frame)
+    scored = model.transform(frame)
+    # scored columns present, with metadata discovery intact
+    assert find_score_column(scored.schema, ScoreKind.SCORED_LABELS) == "scored_labels"
+    assert find_score_column(scored.schema, ScoreKind.SCORED_PROBABILITIES) \
+        == "scored_probabilities"
+    assert scored.schema["scored_labels"].categorical.levels == ["<=50K", ">50K"]
+
+    stats = ComputeModelStatistics()
+    metrics = stats.transform(scored).collect()
+    assert metrics["accuracy"][0] > 0.85
+    assert metrics["AUC"][0] > 0.9
+    assert stats.confusion_matrix.sum() == frame.count()
+
+
+def test_train_classifier_save_load(tmp_path):
+    frame = make_census_like(n=120)
+    model = TrainClassifier(model=LogisticRegression(maxIter=50),
+                            labelCol="income").fit(frame)
+    scored = model.transform(frame)
+    save_stage(model, str(tmp_path / "m"))
+    m2 = load_stage(str(tmp_path / "m"))
+    scored2 = m2.transform(frame)
+    np.testing.assert_allclose(scored.column("scored_labels"),
+                               scored2.column("scored_labels"))
+    assert m2.levels == ["<=50K", ">50K"]
+
+
+def test_train_classifier_multiclass_mlp():
+    rng = np.random.default_rng(1)
+    n = 300
+    X = rng.normal(0, 1, (n, 2))
+    y = (X[:, 0] > 0).astype(int) + 2 * (X[:, 1] > 0).astype(int)  # 4 classes
+    frame = Frame.from_dict({"a": X[:, 0], "b": X[:, 1],
+                             "cls": [f"c{v}" for v in y]})
+    model = TrainClassifier(model=MLPClassifier(maxIter=400),
+                            labelCol="cls").fit(frame)
+    metrics = ComputeModelStatistics().transform(model.transform(frame)).collect()
+    assert metrics["accuracy"][0] > 0.9
+    assert "macro_averaged_precision" in metrics
+
+
+def test_train_classifier_explicit_labels():
+    frame = make_census_like(n=100)
+    model = TrainClassifier(model=LogisticRegression(maxIter=20),
+                            labelCol="income",
+                            labels=[">50K", "<=50K"]).fit(frame)
+    assert model.levels == [">50K", "<=50K"]
+
+
+def test_naive_bayes_text():
+    texts = ["good great fine", "great good", "bad awful", "awful bad sad",
+             "good nice", "terrible bad"]
+    labels = ["pos", "pos", "neg", "neg", "pos", "neg"]
+    frame = Frame.from_dict({"review": texts, "sentiment": labels})
+    model = TrainClassifier(model=NaiveBayes(), labelCol="sentiment").fit(frame)
+    scored = model.transform(frame)
+    metrics = ComputeModelStatistics().transform(scored).collect()
+    assert metrics["accuracy"][0] == 1.0
+
+
+def test_train_regressor_e2e():
+    rng = np.random.default_rng(2)
+    n = 200
+    x1, x2 = rng.normal(0, 1, n), rng.normal(0, 1, n)
+    y = 3 * x1 - 2 * x2 + 0.5 + rng.normal(0, 0.01, n)
+    frame = Frame.from_dict({"x1": x1, "x2": x2, "y": y})
+    model = TrainRegressor(model=LinearRegression(), labelCol="y").fit(frame)
+    scored = model.transform(frame)
+    assert find_score_column(scored.schema, ScoreKind.SCORES) == "scores"
+    metrics = ComputeModelStatistics().transform(scored).collect()
+    assert metrics["r2"][0] > 0.999
+    assert metrics["rmse"][0] < 0.1
+
+
+def test_train_regressor_rejects_string_label():
+    frame = Frame.from_dict({"x": [1.0, 2.0], "y": ["a", "b"]})
+    with pytest.raises(ValueError):
+        TrainRegressor(model=LinearRegression(), labelCol="y").fit(frame)
+
+
+def test_mlp_regressor():
+    rng = np.random.default_rng(3)
+    x = rng.uniform(-2, 2, 300)
+    y = x ** 2
+    frame = Frame.from_dict({"x": x, "y": y})
+    model = TrainRegressor(model=MLPRegressor(maxIter=800), labelCol="y").fit(frame)
+    metrics = ComputeModelStatistics().transform(model.transform(frame)).collect()
+    assert metrics["r2"][0] > 0.95  # nonlinear fit a linear model can't do
+
+
+def test_numeric_noncontiguous_labels():
+    # labels [3, 5, 7] must map through levels, not be used as raw indices
+    rng = np.random.default_rng(7)
+    n = 150
+    x = rng.normal(0, 1, n)
+    y = np.select([x < -0.3, x < 0.3], [3, 5], default=7)
+    frame = Frame.from_dict({"x": x, "lab": y})
+    model = TrainClassifier(model=LogisticRegression(maxIter=200),
+                            labelCol="lab").fit(frame)
+    scored = model.transform(frame)
+    assert model.levels == [3, 5, 7]
+    metrics = ComputeModelStatistics().transform(scored).collect()
+    assert metrics["accuracy"][0] > 0.9
+    from mmlspark_tpu.evaluate.compute_per_instance_statistics import (
+        ComputePerInstanceStatistics)
+    ll = ComputePerInstanceStatistics().transform(scored).column("log_loss")
+    assert np.median(ll) < 1.0  # raw-index bug would give ~34.5 everywhere
+
+
+def test_user_column_named_features_survives():
+    from mmlspark_tpu.core.schema import ColumnSchema, DType
+    frame = make_census_like(n=80)
+    frame = frame.with_column_values(
+        ColumnSchema("features", DType.FLOAT64), np.arange(80, dtype=np.float64))
+    model = TrainClassifier(model=LogisticRegression(maxIter=20),
+                            labelCol="income").fit(frame)
+    scored = model.transform(frame)
+    assert "features" in scored.columns  # user's column not clobbered
+    np.testing.assert_array_equal(scored.column("features")[:5], np.arange(5))
+
+
+def test_stats_instance_reuse_resets_artifacts():
+    frame = make_census_like(n=80)
+    model = TrainClassifier(model=LogisticRegression(maxIter=30),
+                            labelCol="income").fit(frame)
+    scored = model.transform(frame)
+    stats = ComputeModelStatistics()
+    stats.transform(scored)
+    assert stats.roc_curve is not None
+    # regression frame on the same instance must not leak the old curve
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, 50)
+    rframe = Frame.from_dict({"x": x, "y": 2 * x})
+    rmodel = TrainRegressor(model=LinearRegression(), labelCol="y").fit(rframe)
+    stats.transform(rmodel.transform(rframe))
+    assert stats.roc_curve is None
+
+
+# -- metric primitives -------------------------------------------------------
+def test_roc_auc_known_values():
+    labels = np.array([1, 1, 0, 0])
+    scores = np.array([0.9, 0.8, 0.7, 0.1])
+    curve = roc_curve(labels, scores)
+    assert auc_from_roc(curve) == 1.0
+    # random scores -> AUC 0.5 for symmetric case
+    labels = np.array([1, 0])
+    scores = np.array([0.5, 0.5])
+    assert abs(auc_from_roc(roc_curve(labels, scores)) - 0.5) < 1e-9
+
+
+def test_confusion_and_multiclass_metrics():
+    y = np.array([0, 0, 1, 1, 2, 2])
+    pred = np.array([0, 1, 1, 1, 2, 0])
+    cm = confusion_matrix(y, pred, 3)
+    assert cm.tolist() == [[1, 1, 0], [0, 2, 0], [1, 0, 1]]
+    mc = multiclass_metrics(cm)
+    assert abs(mc["accuracy"] - 4 / 6) < 1e-12
+    # macro precision: (1/2 + 2/3 + 1/1)/3
+    assert abs(mc["macro_averaged_precision"] - (0.5 + 2 / 3 + 1.0) / 3) < 1e-12
+
+
+def test_stats_metric_selection():
+    frame = make_census_like(n=80)
+    model = TrainClassifier(model=LogisticRegression(maxIter=30),
+                            labelCol="income").fit(frame)
+    scored = model.transform(frame)
+    only_acc = ComputeModelStatistics(evaluationMetric="accuracy").transform(scored)
+    assert only_acc.columns == ["accuracy"]
+    with pytest.raises(ValueError):
+        ComputeModelStatistics(evaluationMetric="bogus").transform(scored)
