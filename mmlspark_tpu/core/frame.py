@@ -218,14 +218,32 @@ class Frame:
     def with_column(self, col: ColumnSchema,
                     fn: Callable[[Partition], np.ndarray]) -> "Frame":
         """Add/replace a column; ``fn`` maps a partition dict to the new array."""
-        schema = self.schema.add(col)
+        # Two passes: normalize every partition first, then unify on ONE
+        # dtype — otherwise a NaN appearing in only one partition would leave
+        # the schema disagreeing with the other partitions' arrays.
+        normalized = [_normalize(fn(p), col.dtype) for p in self.partitions]
+        actuals = {a for _, a, _ in normalized}
+        final_dtype = col.dtype
+        final_dim = col.dim
+        if len(actuals) == 1:
+            only = next(iter(actuals))
+            if only != col.dtype:
+                final_dtype = only
+        elif actuals and all(a.is_numeric for a in actuals):
+            final_dtype = DType.FLOAT64
+        if col.dtype == DType.VECTOR:
+            dims = {d for _, _, d in normalized if d is not None}
+            if final_dim is None and len(dims) == 1:
+                final_dim = next(iter(dims))
+            elif len(dims) > 1:
+                raise SchemaError(
+                    f"column {col.name!r}: inconsistent vector dims {dims}")
+        schema = self.schema.add(
+            ColumnSchema(col.name, final_dtype, final_dim, col.metadata))
         parts = []
-        for p in self.partitions:
-            arr, actual, dim = _normalize(fn(p), col.dtype)
-            if col.dtype == DType.VECTOR and col.dim is None and dim is not None:
-                schema = schema.add(ColumnSchema(col.name, col.dtype, dim, col.metadata))
-            elif actual != col.dtype:  # e.g. int requested but NaN forced float64
-                schema = schema.add(ColumnSchema(col.name, actual, dim, col.metadata))
+        for p, (arr, actual, _) in zip(self.partitions, normalized):
+            if final_dtype.is_numeric and arr.dtype != final_dtype.numpy_dtype:
+                arr = arr.astype(final_dtype.numpy_dtype)
             q = dict(p)
             q[col.name] = arr
             parts.append(q)

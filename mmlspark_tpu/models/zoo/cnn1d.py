@@ -1,0 +1,49 @@
+"""1-D CNN text classifier — TextFeaturizer + CNN on Amazon reviews
+(BASELINE.json config 4).
+
+Input: integer token ids (B, L) -> embedding -> parallel conv widths ->
+global max pool -> dense head. All convs NWC so XLA maps them to the MXU.
+"""
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mmlspark_tpu.models.zoo import register_model
+
+
+class TextCNN(nn.Module):
+    vocab_size: int
+    embed_dim: int = 128
+    kernel_sizes: Sequence[int] = (3, 4, 5)
+    filters: int = 128
+    num_classes: int = 2
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, ids):
+        x = nn.Embed(self.vocab_size, self.embed_dim, name="embedding",
+                     dtype=self.dtype)(ids)
+        pools = []
+        for k in self.kernel_sizes:
+            h = nn.Conv(self.filters, (k,), padding="SAME", dtype=self.dtype,
+                        name=f"conv{k}")(x)
+            h = nn.relu(h)
+            pools.append(jnp.max(h, axis=1))
+        x = jnp.concatenate(pools, axis=-1).astype(jnp.float32)
+        self.sow("intermediates", "pool", x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+@register_model("textcnn")
+def textcnn(vocab_size: int = 1 << 15, embed_dim: int = 128,
+            num_classes: int = 2, seq_len: int = 256, dtype=jnp.bfloat16):
+    m = TextCNN(vocab_size=vocab_size, embed_dim=embed_dim,
+                num_classes=num_classes, dtype=dtype)
+    return dict(
+        module=m, input_shape=(seq_len,), input_dtype="int32",
+        feature_layer="pool", feature_dim=128 * 3,
+        layer_names=["pool", "head"],
+    )

@@ -1,0 +1,37 @@
+"""MLP for tabular frames — the deep path of TrainClassifier on Adult Census
+(BASELINE.json config 3)."""
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mmlspark_tpu.models.zoo import register_model
+
+
+class MLP(nn.Module):
+    hidden: Sequence[int]
+    num_classes: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        for i, h in enumerate(self.hidden):
+            x = nn.Dense(h, dtype=self.dtype, name=f"mlp_fc{i}")(x)
+            x = nn.relu(x)
+        x = x.astype(jnp.float32)
+        self.sow("intermediates", "pool", x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+@register_model("mlp_tabular")
+def mlp_tabular(input_dim: int = 128, hidden=(512, 256), num_classes: int = 2,
+                dtype=jnp.bfloat16):
+    return dict(
+        module=MLP(hidden=tuple(hidden), num_classes=num_classes, dtype=dtype),
+        input_shape=(input_dim,),
+        feature_layer="pool", feature_dim=hidden[-1],
+        layer_names=["pool", "head"],
+    )

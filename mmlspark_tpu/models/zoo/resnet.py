@@ -1,0 +1,156 @@
+"""ResNets: CIFAR-style ResNet-20 (the benchmark flagship) and ResNet-50.
+
+TPU-first choices:
+- GroupNorm instead of BatchNorm: no running statistics to synchronize
+  across data-parallel replicas, fully functional apply (one pure fn to jit
+  and shard), identical behavior train/eval — the SPMD-friendly norm.
+- NHWC layout (XLA TPU's native conv layout), bfloat16 compute with fp32
+  params and fp32 logits: convs hit the MXU at full rate.
+- Named stages/blocks so intermediates can be selected by layer name for
+  transfer-learning featurization (the reference's ``cutOutputLayers``
+  contract on CNTK graphs, ``image-featurizer/src/main/scala/ImageFeaturizer.scala:93-120``).
+
+Scoring parity target: the CNTK CIFAR-10 ConvNet eval path of notebook 301
+(``cntk-model/src/test/scala/CNTKTestUtils.scala``).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.models.zoo import register_model
+
+
+class ResidualBlock(nn.Module):
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.features, (3, 3), self.strides, padding="SAME",
+                    use_bias=False, dtype=self.dtype, name="conv1")(x)
+        y = nn.GroupNorm(num_groups=min(32, self.features),
+                         dtype=jnp.float32, name="norm1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), padding="SAME",
+                    use_bias=False, dtype=self.dtype, name="conv2")(y)
+        y = nn.GroupNorm(num_groups=min(32, self.features),
+                         dtype=jnp.float32, name="norm2")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype,
+                               name="proj")(residual)
+            residual = nn.GroupNorm(num_groups=min(32, self.features),
+                                    dtype=jnp.float32, name="proj_norm")(residual)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="conv1")(x)
+        y = nn.GroupNorm(num_groups=min(32, self.features), dtype=jnp.float32,
+                         name="norm1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), self.strides, padding="SAME",
+                    use_bias=False, dtype=self.dtype, name="conv2")(y)
+        y = nn.GroupNorm(num_groups=min(32, self.features), dtype=jnp.float32,
+                         name="norm2")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features * 4, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="conv3")(y)
+        y = nn.GroupNorm(num_groups=min(32, self.features * 4),
+                         dtype=jnp.float32, name="norm3")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features * 4, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype,
+                               name="proj")(residual)
+            residual = nn.GroupNorm(num_groups=min(32, self.features * 4),
+                                    dtype=jnp.float32, name="proj_norm")(residual)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class ResNet(nn.Module):
+    """stage_sizes blocks per stage; CIFAR stem (3x3) or ImageNet stem (7x7)."""
+    stage_sizes: Sequence[int]
+    num_classes: int = 10
+    width: int = 16
+    bottleneck: bool = False
+    cifar_stem: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False,
+                        dtype=self.dtype, name="stem_conv")(x)
+        else:
+            x = nn.Conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                        use_bias=False, dtype=self.dtype, name="stem_conv")(x)
+            x = nn.GroupNorm(num_groups=min(32, self.width), dtype=jnp.float32,
+                             name="stem_norm")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        block = BottleneckBlock if self.bottleneck else ResidualBlock
+        for i, n_blocks in enumerate(self.stage_sizes):
+            features = self.width * (2 ** i)
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = block(features, strides, self.dtype,
+                          name=f"stage{i}_block{j}")(x)
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+        self.sow("intermediates", "pool", x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+@register_model("resnet20_cifar")
+def resnet20_cifar(num_classes: int = 10, dtype=jnp.bfloat16):
+    return dict(
+        module=ResNet(stage_sizes=[3, 3, 3], num_classes=num_classes,
+                      width=16, bottleneck=False, cifar_stem=True, dtype=dtype),
+        input_shape=(32, 32, 3),
+        feature_layer="pool", feature_dim=64,
+        layer_names=["pool", "head"],
+    )
+
+
+@register_model("resnet50")
+def resnet50(num_classes: int = 1000, dtype=jnp.bfloat16):
+    return dict(
+        module=ResNet(stage_sizes=[3, 4, 6, 3], num_classes=num_classes,
+                      width=64, bottleneck=True, cifar_stem=False, dtype=dtype),
+        input_shape=(224, 224, 3),
+        feature_layer="pool", feature_dim=2048,
+        layer_names=["pool", "head"],
+    )
+
+
+def apply_with_intermediates(module: nn.Module, params, x):
+    """Forward returning (logits, {layer_name: activation}) for layer selection."""
+    logits, state = module.apply(params, x, capture_intermediates=True,
+                                 mutable=["intermediates"])
+    inters = {}
+
+    def walk(prefix, tree):
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                walk(f"{prefix}{k}/", v)
+            else:
+                inters[f"{prefix}{k}".replace("__call__", "out").rstrip("/")] = \
+                    v[0] if isinstance(v, tuple) else v
+    walk("", state["intermediates"])
+    inters["head"] = logits
+    return logits, inters

@@ -1,0 +1,112 @@
+"""Device mesh construction + multi-host initialization.
+
+The TPU-native replacement for the reference's distributed launch machinery:
+
+- device discovery: ``jax.devices()`` replaces shelling out to ``nvidia-smi``
+  (``core/env/src/main/scala/EnvironmentUtils.scala:20-50``);
+- multi-host: ``jax.distributed.initialize`` replaces the MPI hostfile
+  launcher (``cntk-train/src/main/scala/CommandBuilders.scala:95-117``);
+- the mesh axes are the vocabulary the whole parallel layer speaks:
+  ``data`` (batch), ``fsdp`` (sharded params+batch), ``tensor`` (intra-layer
+  model parallel), ``pipe`` (pipeline stages), ``seq`` (sequence/context
+  parallel for long inputs), ``expert`` (MoE).
+
+Axis layout matters physically: the LAST mesh dimensions map to the
+innermost (fastest, torus-adjacent) ICI rings on real TPU slices, so
+``tensor``/``seq`` — the axes with per-step collectives — are placed last.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "fsdp", "pipe", "expert", "seq", "tensor")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Sizes per logical axis; -1 on `data` means "absorb remaining devices"."""
+    data: int = -1
+    fsdp: int = 1
+    pipe: int = 1
+    expert: int = 1
+    seq: int = 1
+    tensor: int = 1
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = {"data": self.data, "fsdp": self.fsdp, "pipe": self.pipe,
+                 "expert": self.expert, "seq": self.seq, "tensor": self.tensor}
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if n_devices % fixed:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed axes product {fixed}")
+        free = [k for k, v in sizes.items() if v == -1]
+        if len(free) > 1:
+            raise ValueError(f"only one axis may be -1, got {free}")
+        if free:
+            sizes[free[0]] = n_devices // fixed
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError(
+                f"axis sizes {sizes} do not multiply to {n_devices} devices")
+        return sizes
+
+
+def make_mesh(spec: Optional[MeshSpec] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh over all (or given) devices with the standard axis order."""
+    devices = list(devices) if devices is not None else jax.devices()
+    spec = spec or MeshSpec()
+    sizes = spec.resolve(len(devices))
+    shape = tuple(sizes[a] for a in AXES)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def data_parallel_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    return make_mesh(MeshSpec(data=-1), devices)
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+    """Join the jax.distributed process group (idempotent).
+
+    One program domain replaces the reference's three-channel split
+    (Spark RPC + MPI ring + shared filesystem, SURVEY.md §2.6): after this
+    call every host sees the global device set and collectives ride ICI
+    within a slice / DCN across slices.
+    """
+    # Do NOT probe jax.process_count() here: it initializes the backend,
+    # after which distributed init is impossible.
+    from jax._src import distributed as _dist
+    if getattr(_dist.global_state, "client", None) is not None:
+        return  # already initialized
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs = dict(coordinator_address=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        if "already" in str(e).lower():
+            return
+        raise  # a real multi-host init failure must not be silent
+    except ValueError:
+        if coordinator_address is not None:
+            raise  # explicit cluster config that failed is an error
+        # else: no cluster auto-detected — single-process dev/test env
+
+
+def device_count_summary() -> Dict[str, int]:
+    """The `nvidia-smi -L` replacement: structured device inventory."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+    }

@@ -1,0 +1,163 @@
+"""DistributedTrainer: pjit-sharded training steps over the device mesh.
+
+The in-process replacement for the reference's distributed training path
+(``CNTKLearner.fit`` writing text files + launching ``mpiexec -n G cntk ...
+parallelTrain=true``, ``cntk-train/src/main/scala/CNTKLearner.scala:52-162``):
+
+- no subprocess: the train step is one jitted XLA program;
+- no MPI ring: gradients allreduce via the collectives XLA inserts from the
+  GSPMD shardings (psum over ``data``/``fsdp`` riding ICI);
+- no filesystem hand-off: host batches stream via ``shard_batch``;
+- multi-host via ``jax.distributed`` (mesh.py) instead of hostfiles.
+
+Supports dp / fsdp / tensor-parallel out of the box through the sharding
+rules; pipeline and sequence parallel live in their own modules and compose
+via the same mesh.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mmlspark_tpu.parallel.mesh import data_parallel_mesh
+from mmlspark_tpu.parallel.sharding import (
+    batch_sharding, param_shardings, Rules, shard_batch,
+)
+
+LossFn = Callable[[Any, Dict[str, jax.Array], jax.Array], jax.Array]
+
+
+class DistributedTrainer:
+    """Builds sharded init/train/eval steps for a pure loss function.
+
+    loss_fn(params, batch, rng) -> scalar loss (fp32). The whole step —
+    forward, backward, allreduce, optimizer — compiles to one XLA program.
+    """
+
+    def __init__(self, loss_fn: LossFn, optimizer: optax.GradientTransformation,
+                 mesh: Optional[Mesh] = None, rules: Optional[Rules] = None,
+                 accum_steps: int = 1, seq_axis: Optional[str] = None,
+                 remat: bool = False):
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh or data_parallel_mesh()
+        self.rules = rules
+        self.accum_steps = accum_steps
+        self.seq_axis = seq_axis
+        self.remat = remat
+        self._state_shardings = None
+        self._train_step = None
+        self._eval_step = None
+
+    # -- state -------------------------------------------------------------
+    def init(self, init_params_fn: Callable[[], Any]) -> Dict[str, Any]:
+        """Initialize sharded state; params materialize directly into their
+        shards (no host-side full copy on any single device)."""
+        def full_init():
+            params = init_params_fn()
+            return {"params": params,
+                    "opt_state": self.optimizer.init(params),
+                    "step": jnp.zeros((), jnp.int32)}
+
+        abstract = jax.eval_shape(full_init)
+        # Optimizer state mirrors the param tree (adam mu/nu paths contain the
+        # same leaf names), so one rule pass shards params AND opt state.
+        self._state_shardings = param_shardings(abstract, self.mesh, self.rules)
+        with self.mesh:
+            return jax.jit(full_init, out_shardings=self._state_shardings)()
+
+    def state_sharding_spec(self) -> Any:
+        return self._state_shardings
+
+    # -- steps -------------------------------------------------------------
+    def _build_train_step(self):
+        loss_fn = self.loss_fn
+        if self.remat:
+            loss_fn = jax.checkpoint(loss_fn)
+        batch_shard = batch_sharding(self.mesh, seq_axis=self.seq_axis)
+        accum = self.accum_steps
+
+        def single_grad(params, batch, rng):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+            return loss, grads
+
+        def step(state, batch, rng):
+            params = state["params"]
+            rng = jax.random.fold_in(rng, state["step"])
+            if accum > 1:
+                # microbatch gradient accumulation via scan: trades HBM for
+                # one weight update per `accum` forward/backward passes
+                def micro(carry, mb):
+                    loss_acc, grad_acc = carry
+                    loss, grads = single_grad(params, mb, rng)
+                    return (loss_acc + loss,
+                            jax.tree_util.tree_map(jnp.add, grad_acc, grads)), None
+                microbatches = jax.tree_util.tree_map(
+                    lambda x: x.reshape((accum, x.shape[0] // accum) + x.shape[1:]),
+                    batch)
+                zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+                (loss, grads), _ = jax.lax.scan(micro, (0.0, zero), microbatches)
+                loss = loss / accum
+                grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+            else:
+                loss, grads = single_grad(params, batch, rng)
+            updates, opt_state = self.optimizer.update(
+                grads, state["opt_state"], params)
+            new_params = optax.apply_updates(params, updates)
+            new_state = {"params": new_params, "opt_state": opt_state,
+                         "step": state["step"] + 1}
+            return new_state, {"loss": loss}
+
+        return jax.jit(
+            step,
+            in_shardings=(self._state_shardings, batch_shard, None),
+            out_shardings=(self._state_shardings, None),
+            donate_argnums=(0,))
+
+    def train_step(self, state, batch, rng) -> Tuple[Any, Dict[str, jax.Array]]:
+        if self._train_step is None:
+            if self._state_shardings is None:
+                raise RuntimeError("call init() before train_step()")
+            self._train_step = self._build_train_step()
+        with self.mesh:
+            return self._train_step(state, batch, rng)
+
+    def eval_step(self, state, batch, rng) -> jax.Array:
+        if self._state_shardings is None:
+            raise RuntimeError("call init() before eval_step()")
+        if self._eval_step is None:
+            batch_shard = batch_sharding(self.mesh, seq_axis=self.seq_axis)
+            self._eval_step = jax.jit(
+                lambda params, batch, rng: self.loss_fn(params, batch, rng),
+                in_shardings=(self._state_shardings["params"], batch_shard, None),
+            )
+        with self.mesh:
+            return self._eval_step(state["params"], batch, rng)
+
+    # -- data --------------------------------------------------------------
+    def put_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+        with self.mesh:
+            return shard_batch(self.mesh, batch, seq_axis=self.seq_axis)
+
+    def fit(self, state, batches: Iterable[Dict[str, np.ndarray]],
+            rng: Optional[jax.Array] = None,
+            log_every: int = 0,
+            log_fn: Callable[[int, float], None] = None) -> Tuple[Any, list]:
+        """Drive an epoch of host batches through the sharded step."""
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        losses = []
+        for i, host_batch in enumerate(batches):
+            batch = self.put_batch(host_batch)
+            state, metrics = self.train_step(state, batch, rng)
+            if log_every and i % log_every == 0:
+                loss = float(metrics["loss"])
+                losses.append(loss)
+                if log_fn:
+                    log_fn(i, loss)
+        return state, losses

@@ -187,6 +187,21 @@ def test_pipeline_fit_skips_transforms_after_last_estimator(basic_frame):
     assert calls == []  # all-transformer pipeline: fit touches nothing
 
 
+def test_with_column_unifies_dtype_across_partitions():
+    # None in only ONE partition must still give a single coherent dtype
+    f = Frame.from_dict({"i": [0, 1, 2, 3]}).repartition(2)
+
+    def maybe_none(p):
+        vals = p["i"].tolist()
+        return [None if v == 3 else float(v) for v in vals]
+
+    g = f.with_column(ColumnSchema("o", DType.INT32), maybe_none)
+    assert g.schema["o"].dtype == DType.FLOAT64
+    for part in g.partitions:
+        assert part["o"].dtype == np.float64
+    assert np.isnan(g.column("o")[3])
+
+
 def test_frame_with_column_values():
     f = Frame.from_dict({"x": np.arange(6)}).repartition(2)
     g = f.with_column_values(ColumnSchema("y", DType.FLOAT32), np.ones(6))

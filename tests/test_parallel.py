@@ -1,0 +1,148 @@
+"""Distributed layer tests on the virtual 8-device CPU mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from mmlspark_tpu.parallel.mesh import (
+    MeshSpec, data_parallel_mesh, device_count_summary, make_mesh,
+)
+from mmlspark_tpu.parallel.sharding import (
+    DEFAULT_RULES, batch_sharding, param_shardings, shard_batch,
+)
+from mmlspark_tpu.parallel.trainer import DistributedTrainer
+
+
+def test_mesh_spec_resolution():
+    assert MeshSpec(data=-1).resolve(8) == {
+        "data": 8, "fsdp": 1, "pipe": 1, "expert": 1, "seq": 1, "tensor": 1}
+    assert MeshSpec(data=-1, tensor=2).resolve(8)["data"] == 4
+    with pytest.raises(ValueError):
+        MeshSpec(data=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, fsdp=-1).resolve(8)
+
+
+def test_make_mesh_axes():
+    mesh = make_mesh(MeshSpec(data=2, tensor=2, seq=2))
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 1, "pipe": 1, "expert": 1,
+                                "seq": 2, "tensor": 2}
+    assert data_parallel_mesh().shape["data"] == 8
+    s = device_count_summary()
+    assert s["global_devices"] == 8
+
+
+def test_param_sharding_rules():
+    mesh = make_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    params = {"encoder": {"attn": {"qkv": {"kernel": np.zeros((128, 256))}},
+                          "mlp": {"fc1_up": {"kernel": np.zeros((128, 512))}}},
+              "norm": {"scale": np.zeros((128,))}}
+    sh = param_shardings(params, mesh)
+    assert sh["encoder"]["attn"]["qkv"]["kernel"].spec == P("fsdp", "tensor")
+    assert sh["encoder"]["mlp"]["fc1_up"]["kernel"].spec == P("fsdp", "tensor")
+    assert sh["norm"]["scale"].spec == P(None)  # replicated
+    # size-1 axes are clamped out of the spec (equivalent, cheaper to encode)
+    dp_mesh = make_mesh(MeshSpec(data=2, tensor=4))
+    sh2 = param_shardings(params, dp_mesh)
+    assert sh2["encoder"]["attn"]["qkv"]["kernel"].spec == P(None, "tensor")
+    # indivisible dims fall back to replicated on that dim
+    tiny = {"attn": {"qkv": {"kernel": np.zeros((3, 5))}}}
+    assert param_shardings(tiny, mesh)["attn"]["qkv"]["kernel"].spec == P(None, None)
+
+
+def test_shard_batch_places_on_data_axis():
+    mesh = data_parallel_mesh()
+    batch = shard_batch(mesh, {"x": np.zeros((16, 4), np.float32)})
+    assert batch["x"].sharding.spec == P(("data",))
+    # each device holds 1/8 of the batch
+    shard_shapes = {s.data.shape for s in batch["x"].addressable_shards}
+    assert shard_shapes == {(2, 4)}
+
+
+def test_trainer_converges_dp():
+    """Linear regression via the sharded trainer must drive loss near zero,
+    proving gradients allreduce correctly across the data axis."""
+    rng = np.random.default_rng(0)
+    w_true = np.array([2.0, -3.0, 0.5], np.float32)
+    X = rng.normal(0, 1, (256, 3)).astype(np.float32)
+    y = X @ w_true
+
+    def loss_fn(params, batch, _rng):
+        pred = batch["x"] @ params["w"]
+        return ((pred - batch["y"]) ** 2).mean()
+
+    trainer = DistributedTrainer(loss_fn, optax.adam(0.1),
+                                 mesh=data_parallel_mesh())
+    state = trainer.init(lambda: {"w": jnp.zeros(3, jnp.float32)})
+    key = jax.random.PRNGKey(0)
+    for _ in range(100):
+        batch = trainer.put_batch({"x": X, "y": y})
+        state, metrics = trainer.train_step(state, batch, key)
+    assert float(metrics["loss"]) < 1e-3
+    w = np.asarray(jax.device_get(state["params"]["w"]))
+    np.testing.assert_allclose(w, w_true, atol=0.05)
+    assert int(jax.device_get(state["step"])) == 100
+
+
+def test_trainer_accum_matches_plain():
+    """accum_steps=2 must produce (numerically close) same first update as
+    a full batch step with the same data."""
+    X = np.arange(16, dtype=np.float32).reshape(8, 2) / 10
+    y = X.sum(axis=1)
+
+    def loss_fn(params, batch, _rng):
+        return ((batch["x"] @ params["w"] - batch["y"]) ** 2).mean()
+
+    def one_step(accum):
+        tr = DistributedTrainer(loss_fn, optax.sgd(0.1),
+                                mesh=data_parallel_mesh(), accum_steps=accum)
+        state = tr.init(lambda: {"w": jnp.zeros(2, jnp.float32)})
+        batch = tr.put_batch({"x": X, "y": y})
+        state, _ = tr.train_step(state, batch, jax.random.PRNGKey(0))
+        return np.asarray(jax.device_get(state["params"]["w"]))
+
+    np.testing.assert_allclose(one_step(1), one_step(2), rtol=1e-5)
+
+
+def test_trainer_tensor_parallel_mlp():
+    """MLP with kernels sharded over `tensor` axis still computes the right
+    loss (XLA inserts the collectives)."""
+    mesh = make_mesh(MeshSpec(data=2, tensor=4))
+    rng = np.random.default_rng(1)
+    X = rng.normal(0, 1, (32, 16)).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.int32)
+
+    def init():
+        k = jax.random.PRNGKey(0)
+        return {"mlp_fc1_up": {"kernel": jax.random.normal(k, (16, 64)) * 0.1},
+                "mlp_fc2_down": {"kernel": jax.random.normal(k, (64, 2)) * 0.1}}
+
+    def loss_fn(params, batch, _rng):
+        h = jax.nn.relu(batch["x"] @ params["mlp_fc1_up"]["kernel"])
+        logits = h @ params["mlp_fc2_down"]["kernel"]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    trainer = DistributedTrainer(loss_fn, optax.adam(0.05), mesh=mesh)
+    state = trainer.init(init)
+    # fc1 kernel sharded over tensor on output dim (fsdp=1 clamps to None)
+    assert state["params"]["mlp_fc1_up"]["kernel"].sharding.spec == P(None, "tensor")
+    key = jax.random.PRNGKey(0)
+    for _ in range(60):
+        batch = trainer.put_batch({"x": X, "y": y})
+        state, metrics = trainer.train_step(state, batch, key)
+    assert float(metrics["loss"]) < 0.1
+
+
+def test_graft_entry_dryrun():
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)
+
+
+def test_graft_entry_forward():
+    import __graft_entry__ as g
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (8, 10)
