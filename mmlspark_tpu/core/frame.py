@@ -243,6 +243,10 @@ class Frame:
         parts = []
         for p, (arr, actual, _) in zip(self.partitions, normalized):
             if final_dtype.is_numeric and arr.dtype != final_dtype.numpy_dtype:
+                if arr.dtype == np.object_:
+                    raise SchemaError(
+                        f"column {col.name!r}: declared {final_dtype.value} but "
+                        "produced non-numeric values")
                 arr = arr.astype(final_dtype.numpy_dtype)
             q = dict(p)
             q[col.name] = arr

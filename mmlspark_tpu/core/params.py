@@ -166,6 +166,7 @@ class Params:
         if isinstance(param, str):
             param = self.get_param(param)
         self._paramMap[param.name] = param.validate(value)
+        self._jit_cache = None  # compiled closures may capture param values
         return self
 
     def set_params(self, **kwargs) -> "Params":

@@ -1,0 +1,181 @@
+"""ModelDownloader: pretrained-model repository with manifest + sha256.
+
+Re-expression of ``downloader/src/main/scala/ModelDownloader.scala:24-260``
+and ``Schema.scala:31-92``:
+
+- ``ModelSchema`` keeps the reference's fields (name/dataset/modelType/uri/
+  hash/size/inputNode/numLayers/layerNames) so repository listings are
+  drop-in compatible;
+- ``LocalRepo`` = the reference's HDFSRepo idea: a cache directory holding
+  model blobs + ``.meta`` JSON sidecars;
+- ``HttpRepo`` = DefaultModelRepo: a base URL serving a MANIFEST file of
+  schema JSON lines (fetch via urllib; sha256-verified on arrival);
+- model payloads are ``.npz`` param archives loadable straight into JaxModel.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import urllib.request
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class ModelSchema:
+    name: str
+    architecture: str = ""           # zoo key (the reference's modelType)
+    dataset: str = ""
+    uri: str = ""
+    hash: str = ""                   # sha256 hex of the payload
+    size: int = 0
+    inputNode: str = ""
+    numLayers: int = 0
+    layerNames: List[str] = field(default_factory=list)
+    architectureArgs: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), sort_keys=True)
+
+    @staticmethod
+    def from_json(s: str) -> "ModelSchema":
+        return ModelSchema(**json.loads(s))
+
+
+def sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+class Repository:
+    """Abstract model repository (reference Repository[S <: Schema])."""
+
+    def list_schemas(self) -> Iterable[ModelSchema]:
+        raise NotImplementedError
+
+    def get_model_path(self, schema: ModelSchema) -> str:
+        raise NotImplementedError
+
+    def find_by_name(self, name: str) -> ModelSchema:
+        for s in self.list_schemas():
+            if s.name == name:
+                return s
+        raise KeyError(f"model {name!r} not found in repository")
+
+
+class LocalRepo(Repository):
+    """Directory cache: <name>.npz payload + <name>.meta sidecar."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def list_schemas(self) -> List[ModelSchema]:
+        out = []
+        for fn in sorted(os.listdir(self.root)):
+            if fn.endswith(".meta"):
+                with open(os.path.join(self.root, fn)) as f:
+                    out.append(ModelSchema.from_json(f.read()))
+        return out
+
+    def get_model_path(self, schema: ModelSchema) -> str:
+        path = os.path.join(self.root, f"{schema.name}.npz")
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"model payload missing: {path}")
+        if schema.hash:
+            actual = sha256_file(path)
+            if actual != schema.hash:
+                raise IOError(f"sha256 mismatch for {schema.name}: "
+                              f"{actual} != {schema.hash}")
+        return path
+
+    def save_model(self, schema: ModelSchema, params: Any) -> ModelSchema:
+        """Flatten a param pytree into an npz payload + write sidecar."""
+        flat = _flatten_params(params)
+        path = os.path.join(self.root, f"{schema.name}.npz")
+        np.savez(path, **flat)
+        schema.hash = sha256_file(path)
+        schema.size = os.path.getsize(path)
+        with open(os.path.join(self.root, f"{schema.name}.meta"), "w") as f:
+            f.write(schema.to_json())
+        return schema
+
+
+class HttpRepo(Repository):
+    """Remote repository: <base>/MANIFEST lists schema JSON, one per line."""
+
+    def __init__(self, base_url: str, cache: LocalRepo):
+        self.base_url = base_url.rstrip("/")
+        self.cache = cache
+
+    def list_schemas(self) -> List[ModelSchema]:
+        with urllib.request.urlopen(f"{self.base_url}/MANIFEST") as r:
+            lines = r.read().decode("utf-8").splitlines()
+        return [ModelSchema.from_json(l) for l in lines if l.strip()]
+
+    def get_model_path(self, schema: ModelSchema) -> str:
+        path = os.path.join(self.cache.root, f"{schema.name}.npz")
+        if not os.path.exists(path):
+            url = schema.uri or f"{self.base_url}/{schema.name}.npz"
+            with urllib.request.urlopen(url) as r, open(path, "wb") as f:
+                f.write(r.read())
+            with open(os.path.join(self.cache.root,
+                                   f"{schema.name}.meta"), "w") as f:
+                f.write(schema.to_json())
+        return self.cache.get_model_path(schema)
+
+
+class ModelDownloader:
+    """Facade (reference ModelDownloader): resolve name -> local npz path,
+    and hydrate a JaxModel from it."""
+
+    def __init__(self, repo: Repository):
+        self.repo = repo
+
+    def download_by_name(self, name: str) -> str:
+        return self.repo.get_model_path(self.repo.find_by_name(name))
+
+    def load_params(self, name: str) -> Any:
+        path = self.download_by_name(name)
+        with np.load(path, allow_pickle=False) as z:
+            return _unflatten_params({k: z[k] for k in z.files})
+
+    def to_jax_model(self, name: str, **jax_model_kwargs):
+        from mmlspark_tpu.models.jax_model import JaxModel
+        schema = self.repo.find_by_name(name)
+        params = self.load_params(name)
+        m = JaxModel(**jax_model_kwargs)
+        m.set_model(schema.architecture, params=params,
+                    **schema.architectureArgs)
+        return m
+
+
+def _flatten_params(tree, prefix="") -> Dict[str, np.ndarray]:
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_params(v, f"{prefix}{k}␟"))
+    else:
+        out[prefix.rstrip("␟")] = np.asarray(tree)
+    return out
+
+
+def _unflatten_params(flat: Dict[str, np.ndarray]):
+    tree: Dict[str, Any] = {}
+    for key, arr in flat.items():
+        parts = key.split("␟")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return tree

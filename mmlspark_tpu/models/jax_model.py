@@ -1,0 +1,143 @@
+"""JaxModel: score a serialized neural net over frame columns.
+
+The CNTKModel re-expression (``cntk-model/src/main/scala/CNTKModel.scala``):
+
+- the reference broadcast model bytes and ran a per-partition minibatch loop
+  filling ``FloatVectorVector`` element-by-element (``:50-104``) — the perf
+  sin SURVEY.md §7 calls out. Here the model jits ONCE per batch shape and
+  whole contiguous host arrays stream to HBM;
+- final-batch padding + unpadding matches the reference's workaround
+  (``:71-76``, ``:95-97``) but exists for a TPU reason: one static batch
+  shape = one compiled program, no retrace;
+- input coercion Double/Vector -> float32 (``:195-212``) happens in numpy on
+  the host side;
+- output node selection by layer name (``:185-193``) maps to capturing a
+  named intermediate of the zoo module (``cutOutputLayers``/``layerNames``
+  contract used by ImageFeaturizer).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mmlspark_tpu.core.frame import Frame
+from mmlspark_tpu.core.params import (
+    DictParam, HasInputCol, HasOutputCol, IntParam, StringParam,
+)
+from mmlspark_tpu.core.pipeline import Model
+from mmlspark_tpu.core.schema import ColumnSchema, DType, SchemaError
+from mmlspark_tpu.core.serialization import register_stage
+from mmlspark_tpu.models.zoo import build_model
+
+
+@register_stage
+class JaxModel(HasInputCol, HasOutputCol, Model):
+    """Scores a zoo architecture with given params over a vector/image column."""
+
+    architecture = StringParam("architecture", "model zoo architecture name", "")
+    architectureArgs = DictParam("architectureArgs",
+                                 "kwargs for the architecture builder", {})
+    miniBatchSize = IntParam("miniBatchSize", "rows per device batch", 1024,
+                             validator=lambda v: v > 0)
+    outputNodeName = StringParam(
+        "outputNodeName", "layer to emit ('' = final output)", "")
+
+    def set_model(self, architecture: str, params: Optional[Any] = None,
+                  seed: int = 0, **arch_kwargs) -> "JaxModel":
+        """Attach architecture + params (random-init if params is None)."""
+        self.set_params(architecture=architecture,
+                        architectureArgs=dict(arch_kwargs))
+        spec = build_model(architecture, **arch_kwargs)
+        if params is None:
+            module = spec["module"]
+            shape = (1,) + tuple(spec["input_shape"])
+            dtype = jnp.int32 if spec.get("input_dtype") == "int32" else jnp.float32
+            x = jnp.zeros(shape, dtype)
+            params = module.init(jax.random.PRNGKey(seed), x)
+        self._state = {"params": _to_plain(params)}
+        return self
+
+    # -- internals ---------------------------------------------------------
+    def _spec(self) -> Dict[str, Any]:
+        if not self.architecture:
+            raise SchemaError("JaxModel: no architecture set; call set_model()")
+        return build_model(self.architecture, **self.get("architectureArgs"))
+
+    @property
+    def layer_names(self):
+        return list(self._spec()["layer_names"])
+
+    def _build_apply(self):
+        spec = self._spec()
+        module = spec["module"]
+        params = jax.tree_util.tree_map(jnp.asarray, self._state["params"])
+        node = self.outputNodeName
+
+        if not node:
+            @jax.jit
+            def apply(x):
+                return module.apply(params, x)
+            return apply, None
+
+        from mmlspark_tpu.models.zoo.resnet import apply_with_intermediates
+
+        @jax.jit
+        def apply(x):
+            _, inters = apply_with_intermediates(module, params, x)
+            matches = [v for k, v in sorted(inters.items())
+                       if k == node or k.endswith("/" + node)]
+            if not matches:
+                raise SchemaError(
+                    f"output node {node!r} not found; have {sorted(inters)}")
+            return matches[0]
+        return apply, node
+
+    def _coerce_batch(self, arr: np.ndarray, spec) -> np.ndarray:
+        """Host-side input coercion (reference UDFs :195-212) + reshape."""
+        in_shape = tuple(spec["input_shape"])
+        want_int = spec.get("input_dtype") == "int32"
+        arr = np.asarray(arr, dtype=np.int32 if want_int else np.float32)
+        if arr.ndim == 2 and len(in_shape) > 1:
+            if int(np.prod(in_shape)) != arr.shape[1]:
+                raise SchemaError(
+                    f"input width {arr.shape[1]} != prod{in_shape}")
+            arr = arr.reshape((arr.shape[0],) + in_shape)
+        return arr
+
+    def transform(self, frame: Frame) -> Frame:
+        spec = self._spec()
+        apply, _ = self._cached_jit(lambda: self._build_apply())
+        bs = self.miniBatchSize
+        outs = []
+        for batch in frame.batches(bs, cols=[self.inputCol]):
+            x = self._coerce_batch(batch[self.inputCol], spec)
+            n = x.shape[0]
+            if n < bs:  # pad final batch: keep ONE compiled shape
+                pad = np.zeros((bs - n,) + x.shape[1:], x.dtype)
+                x = np.concatenate([x, pad], axis=0)
+            y = np.asarray(jax.device_get(apply(jnp.asarray(x))))
+            outs.append(y[:n])
+        out = np.concatenate(outs, axis=0) if outs \
+            else np.zeros((0, 1), np.float32)
+        if out.ndim == 1:
+            out = out[:, None]
+        col = ColumnSchema(self.outputCol, DType.VECTOR, int(out.shape[1]),
+                           metadata={"model_uid": self.uid,
+                                     "architecture": self.architecture})
+        return frame.with_column_values(col, out.astype(np.float32))
+
+    def transform_schema(self, schema):
+        return schema.add(ColumnSchema(self.outputCol, DType.VECTOR, None))
+
+
+def _to_plain(tree):
+    """FrozenDict / jax arrays -> plain dict of numpy (serializable)."""
+    try:
+        from flax.core import unfreeze
+        tree = unfreeze(tree)
+    except Exception:
+        pass
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)

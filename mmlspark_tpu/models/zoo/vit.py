@@ -1,7 +1,8 @@
 """ViT-B/16 — target of the fused-Pallas-preprocessing config
-(BASELINE.json config 5) and the long-context flagship: its attention
-layers route through ``mmlspark_tpu.parallel.ring_attention`` when a
-``seq`` mesh axis is active.
+(BASELINE.json config 5) and the long-context flagship: every encoder block
+takes a pluggable ``attention_fn``, the hook through which the sequence-
+parallel/ring attention implementations in ``mmlspark_tpu.parallel`` are
+swapped in for long inputs.
 
 Standard pre-norm ViT: patchify conv -> [CLS] -> encoder blocks
 (MHA + MLP, GELU) -> head. bfloat16 compute, fp32 norms/logits.

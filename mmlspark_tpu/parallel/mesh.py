@@ -82,10 +82,8 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     within a slice / DCN across slices.
     """
     # Do NOT probe jax.process_count() here: it initializes the backend,
-    # after which distributed init is impossible.
-    from jax._src import distributed as _dist
-    if getattr(_dist.global_state, "client", None) is not None:
-        return  # already initialized
+    # after which distributed init is impossible. "Already initialized" is
+    # detected from initialize()'s own error instead of private state.
     kwargs = {}
     if coordinator_address is not None:
         kwargs = dict(coordinator_address=coordinator_address,

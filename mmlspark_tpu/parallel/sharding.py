@@ -75,6 +75,12 @@ def param_shardings(params: Any, mesh: Mesh,
         name = _path_str(path)
         ndim = getattr(leaf, "ndim", 0)
         shape = getattr(leaf, "shape", ())
+        if ndim > 2:
+            # conv kernels (H, W, in, out) etc.: never shard spatial dims —
+            # that buys halo collectives for nothing. Shard only the output
+            # features (last dim) over fsdp when divisible.
+            spec = P(*([None] * (ndim - 1) + ["fsdp"]))
+            return NamedSharding(mesh, _fit_spec(spec, ndim, mesh, shape))
         for pattern, spec in rules:
             if re.fullmatch(pattern, name):
                 return NamedSharding(mesh, _fit_spec(spec, ndim, mesh, shape))

@@ -80,7 +80,6 @@ class DistributedTrainer:
         loss_fn = self.loss_fn
         if self.remat:
             loss_fn = jax.checkpoint(loss_fn)
-        batch_shard = batch_sharding(self.mesh, seq_axis=self.seq_axis)
         accum = self.accum_steps
 
         def single_grad(params, batch, rng):
@@ -93,16 +92,20 @@ class DistributedTrainer:
             if accum > 1:
                 # microbatch gradient accumulation via scan: trades HBM for
                 # one weight update per `accum` forward/backward passes
-                def micro(carry, mb):
+                def micro(carry, mb_and_idx):
+                    mb, idx = mb_and_idx
                     loss_acc, grad_acc = carry
-                    loss, grads = single_grad(params, mb, rng)
+                    # distinct rng per microbatch (dropout must differ)
+                    loss, grads = single_grad(params, mb,
+                                              jax.random.fold_in(rng, idx))
                     return (loss_acc + loss,
                             jax.tree_util.tree_map(jnp.add, grad_acc, grads)), None
                 microbatches = jax.tree_util.tree_map(
                     lambda x: x.reshape((accum, x.shape[0] // accum) + x.shape[1:]),
                     batch)
                 zero = jax.tree_util.tree_map(jnp.zeros_like, params)
-                (loss, grads), _ = jax.lax.scan(micro, (0.0, zero), microbatches)
+                (loss, grads), _ = jax.lax.scan(
+                    micro, (0.0, zero), (microbatches, jnp.arange(accum)))
                 loss = loss / accum
                 grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
             else:
@@ -114,9 +117,12 @@ class DistributedTrainer:
                          "step": state["step"] + 1}
             return new_state, {"loss": loss}
 
+        # Batch shardings are NOT pinned here: put_batch commits per-leaf
+        # shardings (rank-aware — labels are rank-1, activations rank-N) and
+        # jit infers from the committed arrays. Pinning a rank-2 spec on the
+        # whole batch dict would crash on rank-1 leaves.
         return jax.jit(
             step,
-            in_shardings=(self._state_shardings, batch_shard, None),
             out_shardings=(self._state_shardings, None),
             donate_argnums=(0,))
 
@@ -132,11 +138,8 @@ class DistributedTrainer:
         if self._state_shardings is None:
             raise RuntimeError("call init() before eval_step()")
         if self._eval_step is None:
-            batch_shard = batch_sharding(self.mesh, seq_axis=self.seq_axis)
             self._eval_step = jax.jit(
-                lambda params, batch, rng: self.loss_fn(params, batch, rng),
-                in_shardings=(self._state_shardings["params"], batch_shard, None),
-            )
+                lambda params, batch, rng: self.loss_fn(params, batch, rng))
         with self.mesh:
             return self._eval_step(state["params"], batch, rng)
 
@@ -155,9 +158,7 @@ class DistributedTrainer:
         for i, host_batch in enumerate(batches):
             batch = self.put_batch(host_batch)
             state, metrics = self.train_step(state, batch, rng)
-            if log_every and i % log_every == 0:
-                loss = float(metrics["loss"])
-                losses.append(loss)
-                if log_fn:
-                    log_fn(i, loss)
-        return state, losses
+            losses.append(metrics["loss"])  # device scalar: no sync per step
+            if log_every and log_fn and i % log_every == 0:
+                log_fn(i, float(losses[-1]))
+        return state, [float(l) for l in jax.device_get(losses)]

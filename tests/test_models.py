@@ -1,0 +1,157 @@
+"""Model zoo, JaxModel scoring, and downloader tests."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Frame
+from mmlspark_tpu.core.schema import DType, SchemaError
+from mmlspark_tpu.core.serialization import load_stage, save_stage
+from mmlspark_tpu.models.downloader import (
+    LocalRepo, ModelDownloader, ModelSchema, sha256_file,
+)
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.models.zoo import available_models, build_model
+from mmlspark_tpu.models.zoo.resnet import apply_with_intermediates
+
+
+def test_zoo_registry():
+    names = available_models()
+    for expected in ["resnet20_cifar", "resnet50", "mlp_tabular", "textcnn",
+                     "vit_b16", "vit_tiny"]:
+        assert expected in names
+    with pytest.raises(KeyError):
+        build_model("nope")
+
+
+def test_resnet20_forward_shapes():
+    spec = build_model("resnet20_cifar", num_classes=10)
+    m = spec["module"]
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    params = m.init(jax.random.PRNGKey(0), x)
+    out = m.apply(params, x)
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.float32
+    logits, inters = apply_with_intermediates(m, params, x)
+    # feature layer advertised by the spec is capturable
+    pools = [v for k, v in inters.items() if k.endswith("pool")]
+    assert pools and pools[0].shape == (2, spec["feature_dim"])
+
+
+def test_vit_tiny_forward():
+    spec = build_model("vit_tiny", num_classes=5, image_size=16, patch=4)
+    m = spec["module"]
+    x = jnp.zeros((2, 16, 16, 3), jnp.float32)
+    params = m.init(jax.random.PRNGKey(0), x)
+    assert m.apply(params, x).shape == (2, 5)
+
+
+def test_textcnn_forward():
+    spec = build_model("textcnn", vocab_size=100, num_classes=3, seq_len=16)
+    m = spec["module"]
+    ids = jnp.zeros((2, 16), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), ids)
+    assert m.apply(params, ids).shape == (2, 3)
+
+
+# -- JaxModel ---------------------------------------------------------------
+def make_image_frame(n=10, hw=8):
+    rng = np.random.default_rng(0)
+    flat = rng.normal(0, 1, (n, hw * hw * 3)).astype(np.float32)
+    return Frame.from_dict({"img": flat}, num_partitions=2)
+
+
+def test_jax_model_scores_logits():
+    f = make_image_frame()
+    m = JaxModel(inputCol="img", outputCol="out", miniBatchSize=4)
+    m.set_model("vit_tiny", num_classes=7, image_size=8, patch=4)
+    out = m.transform(f)
+    assert out.schema["out"].dtype == DType.VECTOR
+    assert out.schema["out"].dim == 7
+    assert out.count() == 10  # padding removed
+
+
+def test_jax_model_minibatch_padding_consistency():
+    """Same outputs whatever the batch size (pad/unpad correctness)."""
+    f = make_image_frame(n=7)
+    outs = []
+    for bs in (3, 7, 64):
+        m = JaxModel(inputCol="img", outputCol="o", miniBatchSize=bs)
+        m.set_model("vit_tiny", num_classes=4, image_size=8, patch=4, seed=1)
+        outs.append(m.transform(f).column("o"))
+    np.testing.assert_allclose(outs[0], outs[1], atol=2e-2)
+    np.testing.assert_allclose(outs[0], outs[2], atol=2e-2)
+
+
+def test_jax_model_output_node_selection():
+    f = make_image_frame(n=4)
+    m = JaxModel(inputCol="img", outputCol="feat", miniBatchSize=4,
+                 outputNodeName="pool")
+    m.set_model("vit_tiny", num_classes=7, image_size=8, patch=4)
+    out = m.transform(f)
+    assert out.schema["feat"].dim == 192  # vit_tiny feature width
+    assert "pool" in m.layer_names
+
+
+def test_jax_model_save_load(tmp_path):
+    f = make_image_frame(n=4)
+    m = JaxModel(inputCol="img", outputCol="o", miniBatchSize=4)
+    m.set_model("vit_tiny", num_classes=3, image_size=8, patch=4)
+    expected = m.transform(f).column("o")
+    save_stage(m, str(tmp_path / "jm"))
+    m2 = load_stage(str(tmp_path / "jm"))
+    np.testing.assert_allclose(m2.transform(f).column("o"), expected, atol=1e-5)
+
+
+def test_jax_model_bad_width():
+    f = Frame.from_dict({"img": np.zeros((2, 5), np.float32)})
+    m = JaxModel(inputCol="img", outputCol="o")
+    m.set_model("vit_tiny", num_classes=3, image_size=8, patch=4)
+    with pytest.raises(SchemaError):
+        m.transform(f)
+
+
+def test_jax_model_requires_architecture():
+    with pytest.raises(SchemaError):
+        JaxModel(inputCol="img", outputCol="o").transform(
+            Frame.from_dict({"img": np.zeros((1, 4), np.float32)}))
+
+
+# -- downloader -------------------------------------------------------------
+def test_local_repo_roundtrip(tmp_path):
+    repo = LocalRepo(str(tmp_path))
+    spec = build_model("mlp_tabular", input_dim=4, hidden=(8,), num_classes=2)
+    params = spec["module"].init(jax.random.PRNGKey(0),
+                                jnp.zeros((1, 4), jnp.float32))
+    schema = ModelSchema(name="tiny_mlp", architecture="mlp_tabular",
+                         dataset="synthetic",
+                         layerNames=["pool", "head"],
+                         architectureArgs={"input_dim": 4, "hidden": [8],
+                                           "num_classes": 2})
+    schema = repo.save_model(schema, params)
+    assert schema.hash and schema.size > 0
+
+    dl = ModelDownloader(repo)
+    assert dl.download_by_name("tiny_mlp").endswith("tiny_mlp.npz")
+    jm = dl.to_jax_model("tiny_mlp", inputCol="x", outputCol="o",
+                         miniBatchSize=4)
+    f = Frame.from_dict({"x": np.ones((3, 4), np.float32)})
+    out = jm.transform(f)
+    assert out.schema["o"].dim == 2
+    # downloader params == original params bit-for-bit
+    direct = spec["module"].apply(params, jnp.ones((3, 4), jnp.float32))
+    np.testing.assert_allclose(out.column("o"), np.asarray(direct), atol=1e-6)
+
+
+def test_local_repo_hash_verification(tmp_path):
+    repo = LocalRepo(str(tmp_path))
+    schema = ModelSchema(name="m", architecture="mlp_tabular")
+    repo.save_model(schema, {"w": np.ones(3, np.float32)})
+    # corrupt the payload
+    path = str(tmp_path / "m.npz")
+    with open(path, "ab") as f:
+        f.write(b"junk")
+    with pytest.raises(IOError):
+        repo.get_model_path(schema)
+    with pytest.raises(KeyError):
+        repo.find_by_name("ghost")
