@@ -1,0 +1,87 @@
+"""ImageFeaturizer: transfer-learning featurization of image columns.
+
+Re-expression of ``image-featurizer/src/main/scala/ImageFeaturizer.scala:85-128``:
+composes (a) resize to the model's input dims, (b) unroll to a vector,
+(c) JaxModel scoring with ``cutOutputLayers`` selecting how many layers to
+cut off the end — 0 scores the head, 1 emits the pooled feature layer
+(the ``layerNames`` contract of the model zoo / downloader schema).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.frame import Frame
+from mmlspark_tpu.core.params import (
+    AnyParam, DictParam, HasInputCol, HasOutputCol, IntParam, StringParam,
+)
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.core.schema import SchemaError
+from mmlspark_tpu.core.serialization import register_stage
+from mmlspark_tpu.image.transformer import ImageTransformer, UnrollImage
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.models.zoo import build_model
+
+
+@register_stage
+class ImageFeaturizer(HasInputCol, HasOutputCol, Transformer):
+    architecture = StringParam("architecture", "model zoo architecture", "")
+    architectureArgs = DictParam("architectureArgs",
+                                 "architecture builder kwargs", {})
+    cutOutputLayers = IntParam(
+        "cutOutputLayers", "how many layers to cut from the end "
+        "(0 = head logits, 1 = feature layer)", 1,
+        validator=lambda v: v >= 0)
+    miniBatchSize = IntParam("miniBatchSize", "scoring batch size", 512)
+
+    def __init__(self, uid=None, **kwargs):
+        kwargs.setdefault("inputCol", "image")
+        kwargs.setdefault("outputCol", "features")
+        super().__init__(uid, **kwargs)
+
+    def set_model(self, architecture: str, params=None, seed: int = 0,
+                  **arch_kwargs) -> "ImageFeaturizer":
+        self.set_params(architecture=architecture,
+                        architectureArgs=dict(arch_kwargs))
+        jm = JaxModel()
+        jm.set_model(architecture, params=params, seed=seed, **arch_kwargs)
+        self._state = {"params": jm._state["params"]}
+        return self
+
+    def set_model_from_downloader(self, downloader, name: str):
+        schema = downloader.repo.find_by_name(name)
+        return self.set_model(schema.architecture,
+                              params=downloader.load_params(name),
+                              **schema.architectureArgs)
+
+    def transform(self, frame: Frame) -> Frame:
+        if not self.architecture:
+            raise SchemaError("ImageFeaturizer: call set_model() first")
+        spec = build_model(self.architecture, **self.get("architectureArgs"))
+        in_shape = spec["input_shape"]
+        if len(in_shape) != 3:
+            raise SchemaError(
+                f"architecture {self.architecture!r} is not an image model")
+        layer_names = list(spec["layer_names"])
+        cut = self.cutOutputLayers
+        if cut >= len(layer_names):
+            raise SchemaError(
+                f"cutOutputLayers={cut} but model has {len(layer_names)} "
+                f"named layers {layer_names}")
+        node = "" if cut == 0 else layer_names[-(cut + 1)]
+
+        tmp_vec = frame.schema.find_unused_name("_unrolled")
+        resized = ImageTransformer(inputCol=self.inputCol,
+                                   outputCol=self.inputCol) \
+            .resize(in_shape[0], in_shape[1]).transform(frame)
+        unrolled = UnrollImage(inputCol=self.inputCol,
+                               outputCol=tmp_vec).transform(resized)
+        jm = JaxModel(inputCol=tmp_vec, outputCol=self.outputCol,
+                      miniBatchSize=self.miniBatchSize,
+                      outputNodeName=node)
+        jm.set_params(architecture=self.architecture,
+                      architectureArgs=self.get("architectureArgs"))
+        jm._state = {"params": self._state["params"]}
+        out = jm.transform(unrolled)
+        return out.drop(tmp_vec)

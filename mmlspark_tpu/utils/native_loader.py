@@ -1,0 +1,153 @@
+"""NativeLoader: build-on-demand + ctypes loading of the C++ runtime.
+
+Re-expression of the reference's jar-resource native loader
+(``core/env/src/main/scala/NativeLoader.java:29-193``): where the reference
+extracted prebuilt ``.so``s from jars into a temp dir and ``System.load``ed
+them per-partition, we compile the checked-in C++ sources once per machine
+(g++, cached next to the sources) and bind via ctypes. No JNI, no jars.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libmmlimage.so")
+_BUILD_CMD = [
+    "g++", "-O2", "-fPIC", "-shared",
+    os.path.join(_NATIVE_DIR, "imagecodec.cc"),
+    "-o", _LIB_PATH, "-ljpeg", "-lpng", "-lpthread",
+]
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_error: Optional[str] = None
+
+
+def _build() -> None:
+    proc = subprocess.run(_BUILD_CMD, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"native build failed:\n{proc.stderr[-2000:]}")
+
+
+def load_native():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_error
+    with _lock:
+        if _lib is not None or _load_error is not None:
+            return _lib
+        try:
+            src = os.path.join(_NATIVE_DIR, "imagecodec.cc")
+            if (not os.path.exists(_LIB_PATH)
+                    or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)):
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.mml_decode_jpeg.restype = ctypes.c_int
+            lib.mml_decode_jpeg.argtypes = [
+                ctypes.c_char_p, ctypes.c_long,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+            lib.mml_decode_png.restype = ctypes.c_int
+            lib.mml_decode_png.argtypes = lib.mml_decode_jpeg.argtypes
+            lib.mml_encode_jpeg.restype = ctypes.c_int
+            lib.mml_encode_jpeg.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+                ctypes.POINTER(ctypes.c_ulong)]
+            lib.mml_free.restype = None
+            lib.mml_free.argtypes = [ctypes.c_void_p]
+            lib.mml_decode_batch.restype = ctypes.c_int
+            lib.mml_decode_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_long), ctypes.c_int,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.c_int]
+            _lib = lib
+        except (RuntimeError, OSError) as e:
+            _load_error = str(e)
+            _lib = None
+        return _lib
+
+
+def native_available() -> bool:
+    return load_native() is not None
+
+
+def _take_buffer(lib, out_ptr, w: int, h: int) -> np.ndarray:
+    n = w * h * 3
+    arr = np.ctypeslib.as_array(out_ptr, shape=(n,)).copy()
+    lib.mml_free(out_ptr)
+    return arr.reshape(h, w, 3)
+
+
+def native_decode_jpeg(data: bytes) -> Optional[np.ndarray]:
+    lib = load_native()
+    if lib is None:
+        return None
+    out = ctypes.POINTER(ctypes.c_ubyte)()
+    w, h = ctypes.c_int(), ctypes.c_int()
+    rc = lib.mml_decode_jpeg(data, len(data), ctypes.byref(out),
+                             ctypes.byref(w), ctypes.byref(h))
+    if rc != 0:
+        return None
+    return _take_buffer(lib, out, w.value, h.value)
+
+
+def native_decode_png(data: bytes) -> Optional[np.ndarray]:
+    lib = load_native()
+    if lib is None:
+        return None
+    out = ctypes.POINTER(ctypes.c_ubyte)()
+    w, h = ctypes.c_int(), ctypes.c_int()
+    rc = lib.mml_decode_png(data, len(data), ctypes.byref(out),
+                            ctypes.byref(w), ctypes.byref(h))
+    if rc != 0:
+        return None
+    return _take_buffer(lib, out, w.value, h.value)
+
+
+def native_encode_jpeg(img: np.ndarray, quality: int = 90) -> Optional[bytes]:
+    lib = load_native()
+    if lib is None:
+        return None
+    img = np.ascontiguousarray(img, dtype=np.uint8)
+    h, w, _ = img.shape
+    out = ctypes.POINTER(ctypes.c_ubyte)()
+    size = ctypes.c_ulong()
+    rc = lib.mml_encode_jpeg(img.tobytes(), w, h, quality,
+                             ctypes.byref(out), ctypes.byref(size))
+    if rc != 0:
+        return None
+    data = ctypes.string_at(out, size.value)
+    lib.mml_free(out)
+    return data
+
+
+def native_decode_batch(blobs: List[bytes],
+                        n_threads: int = 8) -> List[Optional[np.ndarray]]:
+    """Threaded batch decode (JPEG/PNG); None entries for failures."""
+    lib = load_native()
+    if lib is None:
+        return [None] * len(blobs)
+    n = len(blobs)
+    if n == 0:
+        return []
+    datas = (ctypes.c_char_p * n)(*blobs)
+    sizes = (ctypes.c_long * n)(*[len(b) for b in blobs])
+    outs = (ctypes.POINTER(ctypes.c_ubyte) * n)()
+    widths = (ctypes.c_int * n)()
+    heights = (ctypes.c_int * n)()
+    lib.mml_decode_batch(datas, sizes, n, outs, widths, heights, n_threads)
+    results: List[Optional[np.ndarray]] = []
+    for i in range(n):
+        if widths[i] == 0 or not outs[i]:
+            results.append(None)
+        else:
+            results.append(_take_buffer(lib, outs[i], widths[i], heights[i]))
+    return results

@@ -1,0 +1,256 @@
+"""Image path tests: codecs, readers, transformer, unroll, featurizer, pallas."""
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Frame
+from mmlspark_tpu.core.schema import DType, SchemaError
+from mmlspark_tpu.core.serialization import load_stage, save_stage
+from mmlspark_tpu.image import ops
+from mmlspark_tpu.image.featurizer import ImageFeaturizer
+from mmlspark_tpu.image.transformer import ImageTransformer, UnrollImage
+from mmlspark_tpu.io.codecs import (
+    decode_bmp, decode_image, decode_png, encode_bmp, encode_png,
+)
+from mmlspark_tpu.io.readers import read_binary_files, read_csv, read_images
+
+
+def rand_img(rng, h=12, w=9):
+    return rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+
+
+# -- codecs ------------------------------------------------------------------
+def test_bmp_png_roundtrip(rng):
+    img = rand_img(rng)
+    assert np.array_equal(decode_bmp(encode_bmp(img)), img)
+    assert np.array_equal(decode_png(encode_png(img)), img)
+    assert np.array_equal(decode_image(encode_png(img)), img)
+
+
+def test_decode_garbage_returns_none():
+    assert decode_image(b"not an image") is None
+    assert decode_image(b"") is None
+    assert decode_bmp(b"BMgarbage") is None
+
+
+# -- readers -----------------------------------------------------------------
+def make_image_dir(tmp_path, rng, n=6):
+    d = tmp_path / "imgs"
+    sub = d / "sub"
+    sub.mkdir(parents=True)
+    for i in range(n):
+        target = (sub if i % 2 else d) / f"im{i}.png"
+        target.write_bytes(encode_png(rand_img(rng)))
+    (d / "junk.txt").write_bytes(b"not an image")
+    return str(d)
+
+
+def test_read_images_recursive(tmp_path, rng):
+    d = make_image_dir(tmp_path, rng)
+    flat = read_images(d, recursive=False)
+    assert flat.count() == 3 + 0  # top-level pngs only; junk dropped
+    rec = read_images(d, recursive=True, num_partitions=2)
+    assert rec.count() == 6
+    assert rec.schema["image"].metadata["dropped_undecodable"] == 1
+    img = rec.head(1)[0]["image"]
+    assert img.data.dtype == np.uint8 and img.channels == 3
+
+
+def test_read_images_sample_ratio(tmp_path, rng):
+    d = make_image_dir(tmp_path, rng, n=20)
+    a = read_images(d, recursive=True, sample_ratio=0.5, seed=1)
+    b = read_images(d, recursive=True, sample_ratio=0.5, seed=1)
+    assert a.count() == b.count()  # deterministic under fixed seed
+    assert 0 < a.count() < 21
+
+
+def test_read_binary_files_zip(tmp_path, rng):
+    zpath = tmp_path / "arch.zip"
+    with zipfile.ZipFile(zpath, "w") as z:
+        z.writestr("a.bin", b"\x01\x02")
+        z.writestr("b/c.bin", b"\x03")
+    f = read_binary_files(str(tmp_path), inspect_zip=True)
+    paths = sorted(f.column("path").tolist())
+    assert any(p.endswith("arch.zip/a.bin") for p in paths)
+    assert any(p.endswith("arch.zip/b/c.bin") for p in paths)
+    g = read_binary_files(str(tmp_path), inspect_zip=False)
+    assert g.count() == 1  # just the zip blob itself
+
+
+def test_read_csv(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("a,b,s\n1,2.5,x\n2,,y\n")
+    f = read_csv(str(p))
+    assert f.schema["a"].dtype == DType.INT64
+    assert f.schema["b"].dtype == DType.FLOAT64
+    assert np.isnan(f.column("b")[1])
+    assert f.column("s").tolist() == ["x", "y"]
+
+
+# -- image ops ---------------------------------------------------------------
+def test_resize_shapes_and_identity(rng):
+    img = rand_img(rng, 16, 8)
+    assert ops.resize(img, 8, 4).shape == (8, 4, 3)
+    assert ops.resize(img, 16, 8) is img
+    const = np.full((10, 10, 3), 77, np.uint8)
+    assert np.array_equal(ops.resize(const, 5, 7), np.full((5, 7, 3), 77))
+
+
+def test_crop_and_center_crop(rng):
+    img = rand_img(rng, 10, 10)
+    c = ops.crop(img, 2, 3, 4, 5)
+    assert c.shape == (4, 5, 3)
+    np.testing.assert_array_equal(c, img[3:7, 2:7])
+    cc = ops.center_crop(img, 4, 4)
+    np.testing.assert_array_equal(cc, img[3:7, 3:7])
+    with pytest.raises(ValueError):
+        ops.crop(img, 8, 8, 5, 5)
+
+
+def test_color_format(rng):
+    img = rand_img(rng)
+    gray = ops.color_format(img, ops.BGR2GRAY)
+    assert gray.shape == (12, 9, 1)
+    back = ops.color_format(gray, ops.GRAY2BGR)
+    assert back.shape == (12, 9, 3)
+    rgb = ops.color_format(img, ops.BGR2RGB)
+    np.testing.assert_array_equal(rgb[..., 0], img[..., 2])
+
+
+def test_blur_threshold(rng):
+    img = rand_img(rng)
+    b = ops.blur(img, 3, 3)
+    assert b.shape == img.shape
+    const = np.full((6, 6, 3), 100, np.uint8)
+    np.testing.assert_array_equal(ops.blur(const, 3, 3), const)
+    t = ops.threshold(img, 127, 255)
+    assert set(np.unique(t)).issubset({0, 255})
+
+
+def test_gaussian_kernel_normalized():
+    k = ops.gaussian_kernel_1d(5, 1.0)
+    assert abs(k.sum() - 1.0) < 1e-6
+    assert k[2] == k.max()
+
+
+# -- ImageTransformer --------------------------------------------------------
+def make_image_frame(rng, n=4, h=12, w=9):
+    from mmlspark_tpu.core.schema import ImageValue
+    arr = np.empty(n, dtype=np.object_)
+    for i in range(n):
+        arr[i] = ImageValue(path=f"mem://{i}", data=rand_img(rng, h, w))
+    return Frame.from_dict({"image": arr})
+
+
+def test_image_transformer_pipeline(rng, tmp_path):
+    f = make_image_frame(rng)
+    it = ImageTransformer().resize(8, 8).center_crop(6, 6) \
+        .color_format(ops.BGR2GRAY)
+    out = it.transform(f)
+    img = out.head(1)[0]["image"]
+    assert img.data.shape == (6, 6, 1)
+    # stage list survives save/load (ArrayMapParam equivalent)
+    save_stage(it, str(tmp_path / "it"))
+    it2 = load_stage(str(tmp_path / "it"))
+    img2 = it2.transform(f).head(1)[0]["image"]
+    np.testing.assert_array_equal(img.data, img2.data)
+
+
+def test_image_transformer_binary_input(rng):
+    blobs = [encode_png(rand_img(rng)) for _ in range(3)]
+    f = Frame.from_dict({"b": blobs})
+    out = ImageTransformer(inputCol="b", outputCol="img").resize(5, 5).transform(f)
+    assert out.head(1)[0]["img"].data.shape == (5, 5, 3)
+
+
+def test_image_transformer_unknown_stage():
+    it = ImageTransformer(stages=[{"op": "warp"}])
+    with pytest.raises(SchemaError):
+        it.transform(make_image_frame(np.random.default_rng(0)))
+
+
+def test_unroll_image(rng):
+    f = make_image_frame(rng, n=3, h=4, w=5)
+    out = UnrollImage(inputCol="image", outputCol="vec").transform(f)
+    assert out.schema["vec"].dim == 4 * 5 * 3
+    # HWC order: first 3 values = BGR of top-left pixel
+    first = out.column("vec")[0][:3]
+    np.testing.assert_array_equal(first, f.head(1)[0]["image"].data[0, 0])
+    ragged = make_image_frame(rng, n=2, h=4, w=5).union(
+        make_image_frame(rng, n=1, h=6, w=5))
+    with pytest.raises(SchemaError):
+        UnrollImage(inputCol="image", outputCol="v").transform(ragged)
+
+
+# -- ImageFeaturizer ---------------------------------------------------------
+def test_image_featurizer_features_and_logits(rng):
+    f = make_image_frame(rng, n=3, h=20, w=30)
+    feat = ImageFeaturizer(cutOutputLayers=1, miniBatchSize=4)
+    feat.set_model("vit_tiny", num_classes=9, image_size=8, patch=4)
+    out = feat.transform(f)
+    assert out.schema["features"].dim == 192  # pooled features
+    logits = ImageFeaturizer(cutOutputLayers=0, miniBatchSize=4)
+    logits.set_model("vit_tiny", num_classes=9, image_size=8, patch=4)
+    out2 = logits.transform(f)
+    assert out2.schema["features"].dim == 9
+    with pytest.raises(SchemaError):
+        ImageFeaturizer(cutOutputLayers=5).set_model(
+            "vit_tiny", num_classes=9, image_size=8, patch=4).transform(f)
+
+
+def test_image_featurizer_save_load(rng, tmp_path):
+    f = make_image_frame(rng, n=2, h=10, w=10)
+    feat = ImageFeaturizer(cutOutputLayers=1, miniBatchSize=2)
+    feat.set_model("vit_tiny", num_classes=4, image_size=8, patch=4)
+    expected = feat.transform(f).column("features")
+    save_stage(feat, str(tmp_path / "feat"))
+    f2 = load_stage(str(tmp_path / "feat"))
+    np.testing.assert_allclose(f2.transform(f).column("features"), expected,
+                               atol=1e-5)
+
+
+def test_unroll_with_empty_partition(rng, tmp_path):
+    # more partitions than images: empty partitions must not break unroll
+    d = tmp_path / "few"
+    d.mkdir()
+    for i in range(3):
+        (d / f"i{i}.png").write_bytes(encode_png(rand_img(rng, 6, 6)))
+    f = read_images(str(d), num_partitions=4)
+    out = UnrollImage(inputCol="image", outputCol="v").transform(f)
+    assert out.schema["v"].dim == 6 * 6 * 3
+    assert out.count() == 3
+
+
+def test_zip_entries_sampled_once(tmp_path, rng):
+    zpath = tmp_path / "many.zip"
+    with zipfile.ZipFile(zpath, "w") as z:
+        for i in range(40):
+            z.writestr(f"e{i}.bin", bytes([i]))
+    # the zip file itself must be exempt from file-level sampling
+    f = read_binary_files(str(tmp_path), sample_ratio=0.5, seed=3)
+    n = f.count()
+    assert 10 < n < 30  # ~0.5 * 40, not ~0.25 * 40 (double sampling)
+
+
+def test_native_batch_decode_used(rng):
+    from mmlspark_tpu.io.readers import _decode_blobs
+    blobs = [encode_png(rand_img(rng)), b"junk", encode_bmp(rand_img(rng))]
+    out = _decode_blobs(blobs)
+    assert out[0].shape == (12, 9, 3)
+    assert out[1] is None
+    assert out[2].shape == (12, 9, 3)  # BMP via python fallback
+
+
+# -- pallas preprocess -------------------------------------------------------
+def test_fused_normalize_matches_numpy(rng):
+    import jax.numpy as jnp
+    from mmlspark_tpu.ops.pallas_preprocess import make_preprocess_fn
+    pre = make_preprocess_fn((6, 6, 3), mean=(1.0, 2.0, 3.0),
+                             std=(2.0, 2.0, 2.0), out_dtype=jnp.float32)
+    u8 = rng.integers(0, 256, (5, 6 * 6 * 3), dtype=np.uint8)
+    out = np.asarray(pre(jnp.asarray(u8)))
+    ref = (u8.reshape(5, 6, 6, 3).astype(np.float32)
+           - np.array([1, 2, 3], np.float32)) / 2.0
+    np.testing.assert_allclose(out, ref, atol=1e-6)
