@@ -56,7 +56,7 @@ class ImageFeaturizer(HasInputCol, HasOutputCol, Transformer):
                               **schema.architectureArgs)
 
     def transform(self, frame: Frame) -> Frame:
-        if not self.architecture:
+        if not self.architecture or "params" not in self._get_state():
             raise SchemaError("ImageFeaturizer: call set_model() first")
         spec = build_model(self.architecture, **self.get("architectureArgs"))
         in_shape = spec["input_shape"]
@@ -71,12 +71,13 @@ class ImageFeaturizer(HasInputCol, HasOutputCol, Transformer):
                 f"named layers {layer_names}")
         node = "" if cut == 0 else layer_names[-(cut + 1)]
 
+        tmp_img = frame.schema.find_unused_name("_resized")
         tmp_vec = frame.schema.find_unused_name("_unrolled")
         resized = ImageTransformer(inputCol=self.inputCol,
-                                   outputCol=self.inputCol) \
+                                   outputCol=tmp_img) \
             .resize(in_shape[0], in_shape[1]).transform(frame)
-        unrolled = UnrollImage(inputCol=self.inputCol,
-                               outputCol=tmp_vec).transform(resized)
+        unrolled = UnrollImage(inputCol=tmp_img,
+                               outputCol=tmp_vec).transform(resized).drop(tmp_img)
         jm = JaxModel(inputCol=tmp_vec, outputCol=self.outputCol,
                       miniBatchSize=self.miniBatchSize,
                       outputNodeName=node)

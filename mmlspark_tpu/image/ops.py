@@ -42,8 +42,9 @@ def resize(img: np.ndarray, height: int, width: int) -> np.ndarray:
     img_f = img.astype(np.float32)
     if img_f.ndim == 2:
         img_f = img_f[:, :, None]
-    top = img_f[y0][:, x0] * (1 - wx) + img_f[y0][:, x1] * wx
-    bot = img_f[y1][:, x0] * (1 - wx) + img_f[y1][:, x1] * wx
+    r0, r1 = img_f[y0], img_f[y1]
+    top = r0[:, x0] * (1 - wx) + r0[:, x1] * wx
+    bot = r1[:, x0] * (1 - wx) + r1[:, x1] * wx
     out = top * (1 - wy) + bot * wy
     if img.dtype == np.uint8:
         out = np.clip(np.rint(out), 0, 255).astype(np.uint8)

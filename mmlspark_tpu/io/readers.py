@@ -65,15 +65,14 @@ def read_binary_files(path: str, recursive: bool = False,
     # Zips are exempt from file-level sampling when inspected — their ENTRIES
     # are sampled instead (reference SamplePathFilter, HadoopUtils.scala:104:
     # `isZipFile(path) && inspectZip || random < sampleRatio`).
-    def is_zip(f: str) -> bool:
-        return inspect_zip and f.endswith(".zip") and zipfile.is_zipfile(f)
-    zips = [f for f in all_files if is_zip(f)]
-    files = sorted(_sample([f for f in all_files if not is_zip(f)],
-                           sample_ratio, seed) + zips)
+    zips = {f for f in all_files
+            if inspect_zip and f.endswith(".zip") and zipfile.is_zipfile(f)}
+    files = sorted(_sample([f for f in all_files if f not in zips],
+                           sample_ratio, seed) + list(zips))
     paths: List[str] = []
     blobs: List[bytes] = []
     for f in files:
-        if is_zip(f):
+        if f in zips:
             with zipfile.ZipFile(f) as z:
                 names = [n for n in sorted(z.namelist())
                          if not n.endswith("/")]

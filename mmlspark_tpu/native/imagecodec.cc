@@ -131,7 +131,8 @@ int mml_decode_png(const unsigned char* data, long size,
                                            nullptr, nullptr, nullptr);
   if (!png) return 1;
   png_infop info = png_create_info_struct(png);
-  unsigned char* buf = nullptr;
+  // volatile: assigned between setjmp and a possible longjmp
+  unsigned char* volatile buf = nullptr;
   if (!info || setjmp(png_jmpbuf(png))) {
     png_destroy_read_struct(&png, &info, nullptr);
     free(buf);

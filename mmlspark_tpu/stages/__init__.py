@@ -1,0 +1,10 @@
+from mmlspark_tpu.stages.stages import (  # noqa: F401
+    CheckpointData,
+    DataConversion,
+    DropColumns,
+    PartitionSample,
+    RenameColumn,
+    Repartition,
+    SelectColumns,
+    SummarizeData,
+)

@@ -1,0 +1,191 @@
+"""Tests for the L5 data-plumbing stages.
+
+Modeled on the reference's per-module suites (e.g.
+``pipeline-stages/src/test/scala``, ``summarize-data/src/test/scala``):
+tiny inline frames, exact expectations.
+"""
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.frame import Frame
+from mmlspark_tpu.core.schema import DType, SchemaError
+from mmlspark_tpu.stages import (
+    CheckpointData, DataConversion, DropColumns, PartitionSample,
+    RenameColumn, Repartition, SelectColumns, SummarizeData,
+)
+
+from conftest import make_basic_frame
+
+
+class TestRepartition:
+    def test_grow_and_shrink(self):
+        f = Frame.from_dict({"x": list(range(10))})
+        g = Repartition(n=4).transform(f)
+        assert g.num_partitions == 4
+        assert g.column("x").tolist() == list(range(10))
+        h = Repartition(n=2).transform(g)
+        assert h.num_partitions == 2
+        assert h.column("x").tolist() == list(range(10))
+
+    def test_disable(self):
+        f = Frame.from_dict({"x": [1, 2, 3]})
+        assert Repartition(n=3, disable=True).transform(f) is f
+
+
+class TestSelectDropRename:
+    def test_select(self, basic_frame):
+        out = SelectColumns(cols=["words", "values"]).transform(basic_frame)
+        assert out.columns == ["words", "values"]
+
+    def test_select_missing_raises(self, basic_frame):
+        with pytest.raises(SchemaError, match="nope"):
+            SelectColumns(cols=["nope"]).transform(basic_frame)
+
+    def test_drop(self, basic_frame):
+        out = DropColumns(cols=["more"]).transform(basic_frame)
+        assert out.columns == ["numbers", "words", "values"]
+
+    def test_rename_preserves_metadata(self, basic_frame):
+        f = basic_frame.with_metadata("numbers", tag="kept")
+        out = RenameColumn(inputCol="numbers", outputCol="nums").transform(f)
+        assert "nums" in out.schema
+        assert out.schema["nums"].metadata["tag"] == "kept"
+
+
+class TestDataConversion:
+    def test_numeric_casts(self):
+        f = Frame.from_dict({"x": [1.7, 2.2, 3.9]})
+        out = DataConversion(cols=["x"], convertTo="integer").transform(f)
+        assert out.schema["x"].dtype == DType.INT32
+        assert out.column("x").tolist() == [1, 2, 3]
+
+    def test_string_to_double(self):
+        f = Frame.from_dict({"x": ["1.5", "2.5", None]})
+        out = DataConversion(cols=["x"], convertTo="double").transform(f)
+        vals = out.column("x")
+        assert vals[0] == 1.5 and vals[1] == 2.5 and np.isnan(vals[2])
+
+    def test_string_to_bool_rejected(self):
+        f = Frame.from_dict({"x": ["true", "false"]})
+        with pytest.raises(SchemaError, match="not supported"):
+            DataConversion(cols=["x"], convertTo="boolean").transform(f)
+
+    def test_to_string(self):
+        f = Frame.from_dict({"x": [1, 2], "b": [True, False]})
+        out = DataConversion(cols=["x", "b"], convertTo="string").transform(f)
+        assert out.column("x").tolist() == ["1", "2"]
+        assert out.column("b").tolist() == ["true", "false"]
+
+    def test_to_categorical_roundtrip(self):
+        f = Frame.from_dict({"c": ["b", "a", "b", "c"]})
+        cat = DataConversion(cols=["c"], convertTo="toCategorical").transform(f)
+        assert cat.schema["c"].is_categorical
+        back = DataConversion(cols=["c"], convertTo="clearCategorical").transform(cat)
+        assert back.column("c").tolist() == ["b", "a", "b", "c"]
+
+    def test_date_string_roundtrip(self):
+        f = Frame.from_dict({"t": ["2017-03-01 10:30:00", "2017-03-02 11:45:00"]})
+        d = DataConversion(cols=["t"], convertTo="date").transform(f)
+        assert d.schema["t"].dtype == DType.INT64
+        assert d.schema["t"].metadata.get("datetime")
+        s = DataConversion(cols=["t"], convertTo="string").transform(d)
+        assert s.column("t").tolist() == ["2017-03-01 10:30:00",
+                                          "2017-03-02 11:45:00"]
+
+    def test_date_to_long_strips_marker(self):
+        f = Frame.from_dict({"t": ["2017-03-01 10:30:00"]})
+        d = DataConversion(cols=["t"], convertTo="date").transform(f)
+        g = DataConversion(cols=["t"], convertTo="long").transform(d)
+        assert "datetime" not in g.schema["t"].metadata
+        assert g.schema["t"].dtype == DType.INT64
+
+    def test_missing_column_raises(self, basic_frame):
+        with pytest.raises(SchemaError):
+            DataConversion(cols=["ghost"], convertTo="double").transform(basic_frame)
+
+
+class TestSummarizeData:
+    def test_full_summary_shape(self, basic_frame):
+        out = SummarizeData().transform(basic_frame)
+        assert out.column("Feature").tolist() == basic_frame.columns
+        assert "Count" in out.columns and "Median" in out.columns \
+            and "Sample Variance" in out.columns and "P99" in out.columns
+
+    def test_exact_stats(self):
+        f = Frame.from_dict({"x": [1.0, 2.0, 3.0, 4.0, np.nan],
+                             "s": ["a", "a", "b", None, "c"]},
+                            num_partitions=2)
+        out = SummarizeData().transform(f).collect()
+        i = out["Feature"].tolist().index("x")
+        assert out["Count"][i] == 4.0
+        assert out["Missing Value Count"][i] == 1.0
+        assert out["Unique Value Count"][i] == 4.0
+        assert out["Min"][i] == 1.0 and out["Max"][i] == 4.0
+        assert out["Median"][i] == 2.5
+        # sample variance of 1..4 = 5/3
+        assert abs(out["Sample Variance"][i] - 5.0 / 3.0) < 1e-12
+        j = out["Feature"].tolist().index("s")
+        assert out["Count"][j] == 4.0 and out["Missing Value Count"][j] == 1.0
+        assert out["Unique Value Count"][j] == 3.0
+        assert np.isnan(out["Median"][j])  # non-numeric: NaN fill
+
+    def test_toggles(self, basic_frame):
+        out = SummarizeData(basic=False, sample=False,
+                            percentiles=False).transform(basic_frame)
+        assert out.columns == ["Feature", "Count", "Unique Value Count",
+                               "Missing Value Count"]
+
+
+class TestPartitionSample:
+    def test_head(self):
+        f = Frame.from_dict({"x": list(range(100))}, num_partitions=4)
+        out = PartitionSample(mode="Head", count=7).transform(f)
+        assert out.column("x").tolist() == list(range(7))
+
+    def test_random_percent(self):
+        f = Frame.from_dict({"x": list(range(2000))}, num_partitions=4)
+        out = PartitionSample(mode="RandomSample", percent=0.25,
+                              seed=7).transform(f)
+        n = out.count()
+        assert 350 < n < 650  # ~500 expected
+
+    def test_random_absolute(self):
+        f = Frame.from_dict({"x": list(range(2000))}, num_partitions=4)
+        out = PartitionSample(mode="RandomSample", rsMode="Absolute",
+                              count=200, seed=7).transform(f)
+        assert 120 < out.count() < 280
+
+    def test_deterministic_with_seed(self):
+        f = Frame.from_dict({"x": list(range(500))})
+        a = PartitionSample(percent=0.5, seed=3).transform(f).column("x")
+        b = PartitionSample(percent=0.5, seed=3).transform(f).column("x")
+        assert a.tolist() == b.tolist()
+
+    def test_assign_to_partition(self):
+        f = Frame.from_dict({"x": list(range(50))})
+        out = PartitionSample(mode="AssignToPartition", numParts=5,
+                              seed=1).transform(f)
+        col = out.column("Partition")
+        assert out.schema["Partition"].dtype == DType.INT32
+        assert set(np.unique(col)) <= set(range(5))
+
+
+class TestCheckpointData:
+    def test_passthrough(self, basic_frame):
+        out = CheckpointData().transform(basic_frame)
+        assert out.column("numbers").tolist() == [0, 1, 2, 3]
+        out2 = CheckpointData(removeCheckpoint=True).transform(basic_frame)
+        assert out2.count() == 4
+
+
+class TestStageSaveLoad:
+    def test_roundtrip(self, tmp_path):
+        for stage in [Repartition(n=3), SelectColumns(cols=["a"]),
+                      DataConversion(cols=["x"], convertTo="double"),
+                      SummarizeData(sample=False),
+                      PartitionSample(mode="Head", count=5),
+                      CheckpointData(diskIncluded=True)]:
+            p = str(tmp_path / stage.uid)
+            stage.save(p)
+            loaded = type(stage).load(p)
+            assert loaded.explicit_param_values() == stage.explicit_param_values()
