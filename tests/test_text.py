@@ -1,0 +1,310 @@
+"""Text featurization tests.
+
+Mirrors the reference's text-featurizer suite
+(``text-featurizer/src/test/scala/TextFeaturizerSpec.scala``) and the
+characterization specs for the engine primitives the featurizer relies on
+(``core/ml/src/test/scala/{HashingTFSpec,IDFSpec,NGramSpec,Word2VecSpec}.scala``).
+"""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Frame, Pipeline
+from mmlspark_tpu.core.schema import DType, SchemaError
+from mmlspark_tpu.feature.multi_column_adapter import MultiColumnAdapter
+from mmlspark_tpu.feature.text import (
+    ENGLISH_STOP_WORDS, HashingTF, IDF, NGram, RegexTokenizer,
+    StopWordsRemover, TextFeaturizer, TextFeaturizerModel,
+)
+from mmlspark_tpu.feature.word2vec import Word2Vec, Word2VecModel
+from mmlspark_tpu.ops.hashing import hash_term
+
+
+@pytest.fixture
+def text_frame():
+    return Frame.from_dict({
+        "text": ["The quick brown Fox", "jumps over the lazy dog",
+                 "the the the", None],
+        "label": [0, 1, 0, 1],
+    })
+
+
+# -- RegexTokenizer ----------------------------------------------------------
+def test_tokenizer_gaps_lowercase(text_frame):
+    out = RegexTokenizer(inputCol="text", outputCol="tok").transform(text_frame)
+    toks = out.column("tok")
+    assert list(toks[0]) == ["the", "quick", "brown", "fox"]
+    assert list(toks[3]) == []  # null -> empty
+    assert out.schema["tok"].dtype == DType.TOKENS
+
+
+def test_tokenizer_matches_and_min_length(text_frame):
+    t = RegexTokenizer(inputCol="text", outputCol="tok", gaps=False,
+                       pattern=r"[a-z]+", minTokenLength=4)
+    toks = t.transform(text_frame).column("tok")
+    assert list(toks[0]) == ["quick", "brown"]
+
+
+def test_tokenizer_no_lowercase():
+    f = Frame.from_dict({"text": ["Hello World"]})
+    toks = RegexTokenizer(inputCol="text", outputCol="tok",
+                          toLowercase=False).transform(f).column("tok")
+    assert list(toks[0]) == ["Hello", "World"]
+
+
+def test_tokenizer_rejects_tokens_input():
+    f = Frame.from_dict({"tok": [["already", "tokens"]]})
+    with pytest.raises(SchemaError):
+        RegexTokenizer(inputCol="tok", outputCol="out").transform(f)
+
+
+# -- StopWordsRemover --------------------------------------------------------
+def test_stopwords_default_english():
+    f = Frame.from_dict({"tok": [["the", "Quick", "fox", "AND", "hound"]]})
+    out = StopWordsRemover(inputCol="tok", outputCol="clean").transform(f)
+    assert list(out.column("clean")[0]) == ["Quick", "fox", "hound"]
+
+
+def test_stopwords_case_sensitive():
+    f = Frame.from_dict({"tok": [["the", "The", "fox"]]})
+    out = StopWordsRemover(inputCol="tok", outputCol="clean",
+                           caseSensitive=True).transform(f)
+    assert list(out.column("clean")[0]) == ["The", "fox"]
+
+
+def test_stopwords_custom_list():
+    f = Frame.from_dict({"tok": [["foo", "bar", "baz"]]})
+    out = StopWordsRemover(inputCol="tok", outputCol="clean",
+                           stopWords=["bar"]).transform(f)
+    assert list(out.column("clean")[0]) == ["foo", "baz"]
+
+
+# -- NGram -------------------------------------------------------------------
+def test_ngram_bigrams():
+    f = Frame.from_dict({"tok": [["a", "b", "c"], ["x"], []]})
+    out = NGram(inputCol="tok", outputCol="ng").transform(f)
+    ng = out.column("ng")
+    assert list(ng[0]) == ["a b", "b c"]
+    assert list(ng[1]) == []  # shorter than n -> empty (Spark semantics)
+    assert list(ng[2]) == []
+
+
+def test_ngram_trigrams():
+    f = Frame.from_dict({"tok": [["a", "b", "c", "d"]]})
+    ng = NGram(inputCol="tok", outputCol="ng", n=3).transform(f).column("ng")
+    assert list(ng[0]) == ["a b c", "b c d"]
+
+
+# -- HashingTF ---------------------------------------------------------------
+def test_hashing_tf_counts_and_compaction():
+    f = Frame.from_dict({"tok": [["a", "b", "a"], ["b", "c"]]})
+    model = HashingTF(inputCol="tok", outputCol="tf", numFeatures=1 << 18).fit(f)
+    out = model.transform(f)
+    mat = np.asarray(out.column("tf"))
+    # 3 distinct terms -> 3 active slots (murmur3 has no collisions here)
+    assert mat.shape == (2, 3)
+    # slot ordering is ascending hash-slot index; positions are auditable
+    slots = {t: hash_term(t, 1 << 18) for t in "abc"}
+    order = [t for t, _ in sorted(slots.items(), key=lambda kv: kv[1])]
+    row0 = {t: mat[0][order.index(t)] for t in order}
+    assert row0 == {"a": 2.0, "b": 1.0, "c": 0.0}
+
+
+def test_hashing_tf_binary_and_unseen_terms():
+    train = Frame.from_dict({"tok": [["a", "a", "b"]]})
+    model = HashingTF(inputCol="tok", outputCol="tf", binary=True).fit(train)
+    test = Frame.from_dict({"tok": [["a", "a", "zzz-unseen"]]})
+    mat = np.asarray(model.transform(test).column("tf"))
+    assert mat.max() == 1.0          # binary clamp
+    assert mat.sum() == 1.0          # unseen term dropped, only 'a' present
+
+
+# -- IDF ---------------------------------------------------------------------
+def test_idf_formula():
+    f = Frame.from_dict({"tok": [["a", "b"], ["a"], ["a", "c"]]})
+    tf = HashingTF(inputCol="tok", outputCol="tf").fit(f).transform(f)
+    model = IDF(inputCol="tf", outputCol="tfidf").fit(tf)
+    # df(a)=3, df(b)=1, df(c)=1 over 3 docs; idf = ln((n+1)/(df+1))
+    idf = sorted(model.idf.tolist())
+    expect = sorted([np.log(4 / 4), np.log(4 / 2), np.log(4 / 2)])
+    assert np.allclose(idf, expect, atol=1e-6)
+
+
+def test_idf_min_doc_freq_zeroes_rare_terms():
+    f = Frame.from_dict({"tok": [["a", "b"], ["a"], ["a"]]})
+    tf = HashingTF(inputCol="tok", outputCol="tf").fit(f).transform(f)
+    model = IDF(inputCol="tf", outputCol="tfidf", minDocFreq=2).fit(tf)
+    out = np.asarray(model.transform(tf).column("tfidf"))
+    # 'b' appears in 1 doc < minDocFreq -> weight 0 everywhere
+    assert (out != 0).sum() == 0  # idf(a)=ln(4/4)=0 too; all-zero here
+    model2 = IDF(inputCol="tf", outputCol="tfidf", minDocFreq=0).fit(tf)
+    assert (np.asarray(model2.transform(tf).column("tfidf")) != 0).sum() > 0
+
+
+# -- TextFeaturizer ----------------------------------------------------------
+def test_text_featurizer_end_to_end(text_frame):
+    model = TextFeaturizer(inputCol="text", outputCol="feats").fit(text_frame)
+    out = model.transform(text_frame)
+    assert out.schema["feats"].dtype == DType.VECTOR
+    # intermediates dropped; original columns preserved
+    assert set(out.columns) == {"text", "label", "feats"}
+    mat = np.asarray(out.column("feats"))
+    assert mat.shape[0] == 4
+    assert np.isfinite(mat).all()
+    # "the the the" row: its only term is 'the', present in 3 of 4 docs
+    assert mat[3].sum() == 0  # null text -> empty tokens -> zero vector
+
+
+def test_text_featurizer_tokens_input_auto_detect():
+    f = Frame.from_dict({"tok": [["a", "b"], ["b", "c"]]})
+    model = TextFeaturizer(inputCol="tok", outputCol="f", useIDF=False).fit(f)
+    mat = np.asarray(model.transform(f).column("f"))
+    assert mat.shape == (2, 3)
+
+
+def test_text_featurizer_full_chain(text_frame):
+    model = TextFeaturizer(
+        inputCol="text", outputCol="f", useStopWordsRemover=True,
+        useNGram=True, nGramLength=2, binary=True, useIDF=True).fit(text_frame)
+    out = model.transform(text_frame)
+    assert set(out.columns) == {"text", "label", "f"}
+    assert np.isfinite(np.asarray(out.column("f"))).all()
+
+
+def test_text_featurizer_custom_stopwords(text_frame):
+    model = TextFeaturizer(
+        inputCol="text", outputCol="f", useStopWordsRemover=True,
+        defaultStopWordLanguage="custom", stopWords=["quick", "lazy"],
+        useIDF=False).fit(text_frame)
+    # 'quick' filtered -> not hashed -> narrower feature space than without
+    model2 = TextFeaturizer(inputCol="text", outputCol="f",
+                            useIDF=False).fit(text_frame)
+    w1 = np.asarray(model.transform(text_frame).column("f")).shape[1]
+    w2 = np.asarray(model2.transform(text_frame).column("f")).shape[1]
+    assert w1 < w2
+
+
+def test_text_featurizer_save_load(tmp_path, text_frame):
+    model = TextFeaturizer(inputCol="text", outputCol="f").fit(text_frame)
+    expected = np.asarray(model.transform(text_frame).column("f"))
+    model.save(str(tmp_path / "tfm"))
+    loaded = TextFeaturizerModel.load(str(tmp_path / "tfm"))
+    got = np.asarray(loaded.transform(text_frame).column("f"))
+    assert np.allclose(expected, got)
+
+
+def test_text_featurizer_in_pipeline(text_frame):
+    pipe = Pipeline(stages=[
+        TextFeaturizer(inputCol="text", outputCol="f", useIDF=False)])
+    out = pipe.fit(text_frame).transform(text_frame)
+    assert "f" in out.columns
+
+
+# -- MultiColumnAdapter ------------------------------------------------------
+def test_multi_column_adapter_transformer_base():
+    f = Frame.from_dict({"t1": ["a b", "c d"], "t2": ["e f", "g h"]})
+    adapter = MultiColumnAdapter(
+        baseStage=RegexTokenizer(), inputCols=["t1", "t2"],
+        outputCols=["o1", "o2"])
+    out = adapter.transform(f)
+    assert list(out.column("o1")[0]) == ["a", "b"]
+    assert list(out.column("o2")[1]) == ["g", "h"]
+
+
+def test_multi_column_adapter_estimator_base():
+    from mmlspark_tpu.feature.value_indexer import ValueIndexer
+    f = Frame.from_dict({"c1": ["x", "y", "x"], "c2": ["p", "p", "q"]})
+    adapter = MultiColumnAdapter(
+        baseStage=ValueIndexer(), inputCols=["c1", "c2"],
+        outputCols=["i1", "i2"])
+    model = adapter.fit(f)
+    out = model.transform(f)
+    assert out.schema["i1"].is_categorical
+    assert out.schema["i2"].is_categorical
+
+
+def test_multi_column_adapter_validations():
+    f = Frame.from_dict({"t1": ["a"]})
+    with pytest.raises(Exception):
+        MultiColumnAdapter(baseStage=RegexTokenizer(), inputCols=["missing"],
+                           outputCols=["o"]).transform(f)
+    with pytest.raises(Exception):
+        MultiColumnAdapter(baseStage=RegexTokenizer(), inputCols=["t1"],
+                           outputCols=["t1"]).transform(f)
+    with pytest.raises(Exception):
+        MultiColumnAdapter(baseStage=RegexTokenizer(), inputCols=["t1", "t1"],
+                           outputCols=["o"]).transform(f)
+
+
+# -- Word2Vec ----------------------------------------------------------------
+def _toy_corpus():
+    # 'apple' and 'orange' share contexts; 'motor' lives elsewhere
+    docs = []
+    for fruit in ("apple", "orange"):
+        docs += [["i", "eat", fruit, "every", "day"],
+                 ["fresh", fruit, "juice", "tastes", "sweet"],
+                 ["the", fruit, "tree", "grows", "fast"]] * 6
+    docs += [["the", "motor", "engine", "runs", "fast"],
+             ["repair", "the", "motor", "with", "tools"]] * 6
+    return Frame.from_dict({"tok": docs})
+
+
+def test_word2vec_fit_and_shapes():
+    f = _toy_corpus()
+    model = Word2Vec(inputCol="tok", outputCol="vec", vectorSize=16,
+                     minCount=2, maxIter=3, seed=7).fit(f)
+    vecs = model.get_vectors()
+    assert "apple" in vecs and vecs["apple"].shape == (16,)
+    out = model.transform(f)
+    assert out.schema["vec"].dim == 16
+    assert np.isfinite(np.asarray(out.column("vec"))).all()
+
+
+def test_word2vec_synonyms_cluster():
+    model = Word2Vec(inputCol="tok", outputCol="vec", vectorSize=24,
+                     minCount=2, maxIter=10, stepSize=0.05, seed=3,
+                     batchSize=256).fit(_toy_corpus())
+    vecs = model.get_vectors()
+
+    def cos(a, b):
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+    assert cos(vecs["apple"], vecs["orange"]) > cos(vecs["apple"], vecs["motor"])
+    syns = model.find_synonyms("apple", 3)
+    assert len(syns) == 3 and all(w != "apple" for w, _ in syns)
+
+
+def test_word2vec_transform_averages():
+    model = Word2VecModel(inputCol="tok", outputCol="vec", vectorSize=2)
+    model.set_params(vocabulary=["a", "b"])
+    model._set_state({"vectors": np.array([[1, 0], [0, 1]], np.float32)})
+    f = Frame.from_dict({"tok": [["a", "b"], ["a"], ["zzz"], []]})
+    out = np.asarray(model.transform(f).column("vec"))
+    assert np.allclose(out[0], [0.5, 0.5])
+    assert np.allclose(out[1], [1, 0])
+    assert np.allclose(out[2], [0, 0])  # OOV-only -> zero vector
+    assert np.allclose(out[3], [0, 0])
+
+
+def test_word2vec_save_load(tmp_path):
+    f = _toy_corpus()
+    model = Word2Vec(inputCol="tok", outputCol="vec", vectorSize=8,
+                     minCount=2, maxIter=1, seed=0).fit(f)
+    expected = np.asarray(model.transform(f).column("vec"))
+    model.save(str(tmp_path / "w2v"))
+    loaded = Word2VecModel.load(str(tmp_path / "w2v"))
+    assert np.allclose(expected, np.asarray(loaded.transform(f).column("vec")))
+
+
+def test_tokens_stages_tolerate_null_rows():
+    f = Frame.from_dict({"tok": [["a", "b"], None]})
+    assert list(StopWordsRemover(inputCol="tok", outputCol="s").transform(f).column("s")[1]) == []
+    assert list(NGram(inputCol="tok", outputCol="n").transform(f).column("n")[1]) == []
+    model = HashingTF(inputCol="tok", outputCol="tf").fit(f)
+    assert np.asarray(model.transform(f).column("tf"))[1].sum() == 0
+
+
+def test_multi_column_adapter_duplicate_outputs_rejected():
+    f = Frame.from_dict({"t1": ["a"], "t2": ["b"]})
+    with pytest.raises(Exception):
+        MultiColumnAdapter(baseStage=RegexTokenizer(), inputCols=["t1", "t2"],
+                           outputCols=["o", "o"]).transform(f)
