@@ -69,13 +69,14 @@ class MultiColumnAdapter(Estimator):
         base = self.get("baseStage")
         _check_unary(base)
         self._verify(frame)
+        # Each pair reads only original columns (outputs are verified absent),
+        # so every stage fits directly on the input frame — no intermediate
+        # transforms materialized.
         fitted: List[Transformer] = []
-        cur = frame
         for in_col, out_col in self._pairs():
             stage = self._per_pair(in_col, out_col)
-            model = stage.fit(cur) if isinstance(stage, Estimator) else stage
-            cur = model.transform(cur)
-            fitted.append(model)
+            fitted.append(stage.fit(frame) if isinstance(stage, Estimator)
+                          else stage)
         return PipelineModel(stages=fitted)
 
     def transform(self, frame: Frame) -> Frame:

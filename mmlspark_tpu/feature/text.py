@@ -31,7 +31,7 @@ from mmlspark_tpu.core.params import (
 from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
 from mmlspark_tpu.core.schema import ColumnSchema, DType, SchemaError
 from mmlspark_tpu.core.serialization import register_stage
-from mmlspark_tpu.ops.hashing import hash_terms
+from mmlspark_tpu.ops.hashing import hash_terms, term_frequencies
 
 # A standard English stop-word list (the classic Glasgow IR list that Spark's
 # StopWordsRemover also ships). Public-domain word list.
@@ -198,19 +198,18 @@ class HashingTFModel(HasInputCol, HasOutputCol, Model):
         slots = self.slots  # sorted int64
         width = len(slots)
         binary = self.binary
-        num_features = self.numFeatures
         rows = _token_rows(frame, self.inputCol)
         out = np.zeros((len(rows), width), dtype=np.float32)
-        for r, row in enumerate(rows):
-            if not row:
-                continue
-            uniq, counts = np.unique(hash_terms(row, num_features),
-                                     return_counts=True)
-            pos = np.searchsorted(slots, uniq)
-            ok = (pos < width) & (slots[np.minimum(pos, width - 1)] == uniq)
-            vals = (np.ones_like(counts, np.float32) if binary
-                    else counts.astype(np.float32))
-            out[r, pos[ok]] = vals[ok]  # unseen-at-fit slots are dropped
+        if width:
+            for r, sc in enumerate(term_frequencies(rows, self.numFeatures)):
+                if not len(sc):
+                    continue
+                uniq, counts = sc[:, 0], sc[:, 1]
+                pos = np.searchsorted(slots, uniq)
+                ok = (pos < width) & (slots[np.minimum(pos, width - 1)] == uniq)
+                vals = (np.ones_like(counts, np.float32) if binary
+                        else counts.astype(np.float32))
+                out[r, pos[ok]] = vals[ok]  # unseen-at-fit slots are dropped
         return frame.with_column_values(
             ColumnSchema(self.outputCol, DType.VECTOR, dim=width), out)
 

@@ -104,7 +104,9 @@ class Word2Vec(HasInputCol, HasOutputCol, Estimator):
         table = host_rng.choice(v, size=_TABLE_SIZE, p=p).astype(np.int32)
 
         batch = min(self.batchSize, centers.size)
-        n_batches = centers.size // batch
+        # ceil so the remainder trains too (wrap-padded; duplicates are
+        # harmless for SGD and the shuffle differs per epoch)
+        n_batches = -(-centers.size // batch)
         neg = self.numNegatives
         lr = self.stepSize
 
@@ -146,11 +148,14 @@ class Word2Vec(HasInputCol, HasOutputCol, Estimator):
             host_rng.uniform(-0.5 / dim, 0.5 / dim, (v, dim)).astype(np.float32))
         w_out = jnp.zeros((v, dim), jnp.float32)
         params = (w_in, w_out)
+        padded = n_batches * batch
         for it in range(self.maxIter):
             key, sub = jax.random.split(key)
             perm = host_rng.permutation(centers.size)
-            params, _ = epoch_jit(params, jnp.asarray(centers[perm]),
-                                  jnp.asarray(contexts[perm]), sub)
+            params, _ = epoch_jit(params,
+                                  jnp.asarray(np.resize(centers[perm], padded)),
+                                  jnp.asarray(np.resize(contexts[perm], padded)),
+                                  sub)
         return self._make_model(vocab, np.asarray(params[0]))
 
     def _make_model(self, vocab: List[str], vectors: np.ndarray) -> "Word2VecModel":

@@ -308,3 +308,20 @@ def test_multi_column_adapter_duplicate_outputs_rejected():
     with pytest.raises(Exception):
         MultiColumnAdapter(baseStage=RegexTokenizer(), inputCols=["t1", "t2"],
                            outputCols=["o", "o"]).transform(f)
+
+
+def test_hashing_tf_empty_fit_corpus():
+    train = Frame.from_dict({"tok": [[], None]})
+    model = HashingTF(inputCol="tok", outputCol="tf").fit(train)
+    out = model.transform(Frame.from_dict({"tok": [["a", "b"]]}))
+    assert np.asarray(out.column("tf")).shape == (1, 0)  # degenerate, no crash
+
+
+def test_word2vec_small_pair_count_uses_all_pairs():
+    # fewer pairs than batchSize: remainder must still train (vectors move)
+    docs = [["red", "blue"], ["blue", "red"]] * 3
+    model = Word2Vec(inputCol="tok", outputCol="v", vectorSize=4, minCount=1,
+                     maxIter=5, batchSize=1024, seed=0).fit(
+        Frame.from_dict({"tok": docs}))
+    vecs = model.get_vectors()
+    assert np.abs(vecs["red"]).max() > 0.05  # moved well beyond init scale

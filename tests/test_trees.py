@@ -1,0 +1,238 @@
+"""Tree learner tests: accuracy floors on synthetic data, the methodology of
+the reference's VerifyTrainClassifier benchmark harness
+(``train-classifier/src/test/scala/VerifyTrainClassifier.scala:31-38``).
+"""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Frame
+from mmlspark_tpu.train.trees import (
+    DecisionTreeClassifier, DecisionTreeRegressor, GBTClassifier,
+    GBTClassifierModel, GBTRegressor, RandomForestClassifier,
+    RandomForestRegressor, TreeClassifierModel, TreeRegressorModel,
+    bin_features, grow_tree, make_bin_edges,
+)
+
+
+def _xor_data(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-1, 1, (n, 2)).astype(np.float32)
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(np.int32)
+    return X, y
+
+
+def _frame(X, y):
+    return Frame.from_dict({"features": X, "label": y})
+
+
+def _accuracy(model, X, y):
+    out = model.transform(_frame(X, y))
+    return (out.column("prediction").astype(int) == y).mean()
+
+
+# -- binning -----------------------------------------------------------------
+def test_bin_edges_and_binning():
+    X = np.array([[0.0], [1.0], [2.0], [3.0]], np.float32)
+    edges = make_bin_edges(X, max_bins=8)
+    Xb = bin_features(X, edges)
+    # 4 distinct values -> exact midpoints 0.5, 1.5, 2.5; bins 0..3
+    assert sorted(np.unique(Xb[:, 0]).tolist()) == [0, 1, 2, 3]
+    # going right at split bin b means x > edges[b]
+    assert (X[Xb[:, 0] > 0, 0] > edges[0, 0]).all()
+
+
+def test_binning_nan_goes_left():
+    X = np.array([[1.0], [np.nan], [3.0]], np.float32)
+    edges = make_bin_edges(X, max_bins=4)
+    Xb = bin_features(X, edges)
+    assert Xb[1, 0] == 0  # NaN -> left-most bin
+
+
+def test_constant_feature_has_no_splits():
+    import jax.numpy as jnp
+    X = np.full((16, 1), 2.5, np.float32)
+    y = np.arange(16) % 2
+    edges = make_bin_edges(X, 8)
+    Xb = bin_features(X, edges)
+    feats, bins, leaf_V, leaf_w, node = grow_tree(
+        jnp.asarray(Xb), jnp.asarray(np.eye(2, dtype=np.float32)[y]),
+        jnp.ones(16, jnp.float32), jnp.ones(1, bool), 3, 8, 1e-6, 1.0)
+    assert (np.asarray(bins) == 7).all()     # every node is a dead-end
+    assert np.asarray(node).max() == 0       # all rows in the left-most leaf
+
+
+# -- decision tree -----------------------------------------------------------
+def test_decision_tree_classifier_learns_xor():
+    # greedy CART needs a few spare levels on XOR: the center cut has zero
+    # gain, so early splits peel noise until the grid is carved (sklearn
+    # behaves the same way)
+    X, y = _xor_data()
+    model = DecisionTreeClassifier(maxDepth=6).fit(_frame(X, y))
+    assert _accuracy(model, X, y) > 0.95
+
+
+def test_decision_tree_multiclass():
+    rng = np.random.default_rng(1)
+    X = rng.normal(0, 1, (300, 3)).astype(np.float32)
+    y = np.digitize(X[:, 0], [-0.5, 0.5]).astype(np.int32)  # 3 classes
+    model = DecisionTreeClassifier(maxDepth=4).fit(_frame(X, y))
+    assert _accuracy(model, X, y) > 0.9
+    out = model.transform(_frame(X, y))
+    probs = np.asarray(out.column("probability"))
+    assert probs.shape == (300, 3)
+    assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_decision_tree_regressor_step_function():
+    rng = np.random.default_rng(2)
+    X = rng.uniform(0, 4, (500, 1)).astype(np.float32)
+    y = np.floor(X[:, 0]).astype(np.float32)  # piecewise-constant target
+    model = DecisionTreeRegressor(maxDepth=4).fit(_frame(X, y))
+    pred = model.transform(_frame(X, y)).column("prediction")
+    assert np.abs(pred - y).mean() < 0.05
+
+
+def test_decision_tree_min_instances():
+    X, y = _xor_data(60)
+    deep = DecisionTreeClassifier(maxDepth=6, minInstancesPerNode=1).fit(_frame(X, y))
+    shallow = DecisionTreeClassifier(maxDepth=6, minInstancesPerNode=30).fit(_frame(X, y))
+    # the constrained tree must be coarser: fewer distinct leaf probabilities
+    n_deep = len(np.unique(np.asarray(deep._state["leaf_probs"][0])[:, 0]))
+    n_shallow = len(np.unique(np.asarray(shallow._state["leaf_probs"][0])[:, 0]))
+    assert n_shallow <= n_deep
+
+
+# -- random forest -----------------------------------------------------------
+def test_random_forest_classifier():
+    X, y = _xor_data(500, seed=3)
+    model = RandomForestClassifier(numTrees=15, maxDepth=4, seed=5,
+                                   featureSubsetStrategy="all").fit(_frame(X, y))
+    assert _accuracy(model, X, y) > 0.95
+    assert model._state["feats"].shape[0] == 15
+
+
+def test_random_forest_regressor():
+    rng = np.random.default_rng(4)
+    X = rng.uniform(-2, 2, (600, 2)).astype(np.float32)
+    y = (X[:, 0] ** 2 + X[:, 1]).astype(np.float32)
+    model = RandomForestRegressor(numTrees=20, maxDepth=6,
+                                  featureSubsetStrategy="all", seed=1).fit(_frame(X, y))
+    pred = model.transform(_frame(X, y)).column("prediction")
+    ss_res = ((pred - y) ** 2).sum()
+    ss_tot = ((y - y.mean()) ** 2).sum()
+    assert 1 - ss_res / ss_tot > 0.85  # R^2
+
+
+def test_random_forest_feature_subsetting_differs_across_trees():
+    rng = np.random.default_rng(0)
+    X = rng.normal(0, 1, (200, 16)).astype(np.float32)
+    y = (X[:, 3] > 0).astype(np.int32)
+    model = RandomForestClassifier(numTrees=8, maxDepth=2, seed=2,
+                                   featureSubsetStrategy="sqrt").fit(_frame(X, y))
+    roots = model._state["feats"][:, 0]
+    assert len(np.unique(roots)) > 1  # different trees saw different features
+
+
+# -- GBT ---------------------------------------------------------------------
+def test_gbt_classifier_binary():
+    X, y = _xor_data(500, seed=6)
+    model = GBTClassifier(maxIter=20, maxDepth=3, stepSize=0.3).fit(_frame(X, y))
+    assert _accuracy(model, X, y) > 0.95
+    out = model.transform(_frame(X, y))
+    probs = np.asarray(out.column("probability"))
+    assert probs.shape[1] == 2
+    assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_gbt_classifier_rejects_multiclass():
+    X = np.random.default_rng(0).normal(0, 1, (30, 2)).astype(np.float32)
+    y = np.arange(30) % 3
+    with pytest.raises(ValueError):
+        GBTClassifier().fit(_frame(X, y.astype(np.int32)))
+
+
+def test_gbt_regressor_nonlinear():
+    rng = np.random.default_rng(7)
+    X = rng.uniform(-3, 3, (600, 2)).astype(np.float32)
+    y = (np.sin(X[:, 0]) * 2 + X[:, 1] ** 2).astype(np.float32)
+    model = GBTRegressor(maxIter=40, maxDepth=4, stepSize=0.2).fit(_frame(X, y))
+    pred = model.transform(_frame(X, y)).column("prediction")
+    ss_res = ((pred - y) ** 2).sum()
+    ss_tot = ((y - y.mean()) ** 2).sum()
+    assert 1 - ss_res / ss_tot > 0.9
+
+
+def test_gbt_more_rounds_reduce_training_error():
+    rng = np.random.default_rng(8)
+    X = rng.uniform(-2, 2, (300, 2)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1]).astype(np.float32)
+    errs = []
+    for iters in (1, 10, 40):
+        m = GBTRegressor(maxIter=iters, maxDepth=3, stepSize=0.2).fit(_frame(X, y))
+        pred = m.transform(_frame(X, y)).column("prediction")
+        errs.append(((pred - y) ** 2).mean())
+    assert errs[2] < errs[1] < errs[0]
+
+
+# -- save/load ---------------------------------------------------------------
+@pytest.mark.parametrize("est,model_cls", [
+    (DecisionTreeClassifier(maxDepth=3), TreeClassifierModel),
+    (RandomForestClassifier(numTrees=4, maxDepth=3), TreeClassifierModel),
+    (GBTClassifier(maxIter=4, maxDepth=2), GBTClassifierModel),
+])
+def test_tree_model_save_load(tmp_path, est, model_cls):
+    X, y = _xor_data(120)
+    model = est.fit(_frame(X, y))
+    expected = model.transform(_frame(X, y)).column("prediction")
+    model.save(str(tmp_path / "m"))
+    loaded = model_cls.load(str(tmp_path / "m"))
+    got = loaded.transform(_frame(X, y)).column("prediction")
+    assert (expected == got).all()
+
+
+def test_tree_regressor_save_load(tmp_path):
+    rng = np.random.default_rng(9)
+    X = rng.normal(0, 1, (100, 2)).astype(np.float32)
+    y = X[:, 0].astype(np.float32)
+    model = GBTRegressor(maxIter=3, maxDepth=2).fit(_frame(X, y))
+    expected = model.transform(_frame(X, y)).column("prediction")
+    model.save(str(tmp_path / "m"))
+    loaded = TreeRegressorModel.load(str(tmp_path / "m"))
+    assert np.allclose(expected,
+                       loaded.transform(_frame(X, y)).column("prediction"))
+
+
+# -- TrainClassifier / TrainRegressor integration ----------------------------
+def test_train_classifier_with_trees():
+    from mmlspark_tpu.train.train_classifier import TrainClassifier
+    rng = np.random.default_rng(10)
+    n = 300
+    frame = Frame.from_dict({
+        "age": rng.integers(18, 80, n).astype(np.float64),
+        "hours": rng.uniform(10, 60, n),
+        "job": rng.choice(["a", "b", "c"], n).tolist(),
+        "income": (rng.uniform(0, 1, n) > 0.5).astype(np.int32),
+    })
+    for learner in (DecisionTreeClassifier(maxDepth=3),
+                    RandomForestClassifier(numTrees=5, maxDepth=3),
+                    GBTClassifier(maxIter=5, maxDepth=2)):
+        model = TrainClassifier(model=learner, labelCol="income").fit(frame)
+        out = model.transform(frame)
+        assert "scored_labels" in out.columns
+
+
+def test_train_regressor_with_trees():
+    from mmlspark_tpu.train.train_classifier import TrainRegressor
+    rng = np.random.default_rng(11)
+    n = 200
+    frame = Frame.from_dict({
+        "x1": rng.normal(0, 1, n),
+        "x2": rng.normal(0, 1, n),
+        "target": rng.normal(0, 1, n),
+    })
+    for learner in (DecisionTreeRegressor(maxDepth=3),
+                    RandomForestRegressor(numTrees=5, maxDepth=3),
+                    GBTRegressor(maxIter=5, maxDepth=2)):
+        model = TrainRegressor(model=learner, labelCol="target").fit(frame)
+        out = model.transform(frame)
+        assert "scores" in out.columns
