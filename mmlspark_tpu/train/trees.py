@@ -94,12 +94,16 @@ def bin_features(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
 # the level-wise builder (pure jax; vmap-able over trees)
 def grow_tree(Xb: jnp.ndarray, V: jnp.ndarray, w: jnp.ndarray,
               feat_mask: jnp.ndarray, depth: int, n_bins: int,
-              lam: float, min_child_weight: float):
+              lam: float, min_child_weight: float,
+              counts: Optional[jnp.ndarray] = None):
     """Grow one depth-``depth`` tree.
 
     Xb (n, F) int32 binned features; V (n, C) value channels; w (n,) weights
     (0-weight rows are ignored — that is how bootstrap/boosting masks rows);
-    feat_mask (F,) bool selects splittable features.
+    feat_mask (F,) bool selects splittable features. ``counts`` (n,), when
+    given, is the channel the min-child test uses instead of ``w`` — needed
+    by boosting, where w carries hessians (<=0.25/row for logistic loss) but
+    minInstancesPerNode means ROWS.
 
     Returns (feats (2^depth-1,), bins (2^depth-1,), leaf_V (2^depth, C),
     leaf_w (2^depth,), node (n,) final leaf assignment).
@@ -107,27 +111,33 @@ def grow_tree(Xb: jnp.ndarray, V: jnp.ndarray, w: jnp.ndarray,
     n, F = Xb.shape
     C = V.shape[1]
     B = n_bins
-    S = jnp.concatenate([V, w[:, None]], axis=1)       # (n, C+1)
+    chans = [V, w[:, None]]
+    if counts is not None:
+        chans.append(counts[:, None])
+    S = jnp.concatenate(chans, axis=1)                 # (n, C+1[+1])
+    n_chan = S.shape[1]
     node = jnp.zeros(n, jnp.int32)
     feats_levels, bins_levels = [], []
 
     col_idx = jnp.arange(F, dtype=jnp.int32)[None, :]  # (1, F)
     for d in range(depth):
         n_nodes = 1 << d
-        # histogram over (node, feature, bin) for all C+1 channels at once
+        # histogram over (node, feature, bin) for all channels at once
         idx = ((node[:, None] * F + col_idx) * B + Xb).reshape(-1)
-        vals = jnp.broadcast_to(S[:, None, :], (n, F, C + 1)).reshape(-1, C + 1)
-        hist = jnp.zeros((n_nodes * F * B, C + 1), S.dtype).at[idx].add(vals)
-        hist = hist.reshape(n_nodes, F, B, C + 1)
+        vals = jnp.broadcast_to(S[:, None, :], (n, F, n_chan)).reshape(-1, n_chan)
+        hist = jnp.zeros((n_nodes * F * B, n_chan), S.dtype).at[idx].add(vals)
+        hist = hist.reshape(n_nodes, F, B, n_chan)
 
-        cum = jnp.cumsum(hist, axis=2)                  # (N, F, B, C+1)
-        total = cum[:, :, -1:, :]                       # (N, F, 1, C+1)
+        cum = jnp.cumsum(hist, axis=2)                  # (N, F, B, n_chan)
+        total = cum[:, :, -1:, :]                       # (N, F, 1, n_chan)
         SL, SR = cum, total - cum
         VL, WL = SL[..., :C], SL[..., C]
         VR, WR = SR[..., :C], SR[..., C]
         gain = ((VL ** 2).sum(-1) / (WL + lam)
                 + (VR ** 2).sum(-1) / (WR + lam))       # (N, F, B)
-        ok = ((WL >= min_child_weight) & (WR >= min_child_weight))
+        CL = SL[..., -1] if counts is not None else WL
+        CR = SR[..., -1] if counts is not None else WR
+        ok = ((CL >= min_child_weight) & (CR >= min_child_weight))
         ok &= feat_mask[None, :, None]
         ok = ok.at[:, :, B - 1].set(False)              # last bin: no split
         gain = jnp.where(ok, gain, _NEG)
@@ -153,7 +163,7 @@ def grow_tree(Xb: jnp.ndarray, V: jnp.ndarray, w: jnp.ndarray,
         node = 2 * node + go_right.astype(jnp.int32)
 
     n_leaves = 1 << depth
-    leaf_S = jnp.zeros((n_leaves, C + 1), S.dtype).at[node].add(S)
+    leaf_S = jnp.zeros((n_leaves, n_chan), S.dtype).at[node].add(S)
     feats = jnp.concatenate(feats_levels) if depth else jnp.zeros(0, jnp.int32)
     bins = jnp.concatenate(bins_levels) if depth else jnp.zeros(0, jnp.int32)
     return feats, bins, leaf_S[:, :C], leaf_S[:, C], node
@@ -189,11 +199,16 @@ _TREE_HINTS = FeaturizeHints(one_hot=False, num_features=1 << 12)
 
 def _feature_masks(F: int, n_trees: int, strategy: str, is_classifier: bool,
                    rng: np.random.Generator) -> np.ndarray:
-    """Per-tree boolean feature masks (Spark featureSubsetStrategy)."""
-    if strategy == "all" or n_trees == 1:
-        return np.ones((n_trees, F), bool)
+    """Per-tree boolean feature masks (Spark featureSubsetStrategy).
+
+    'auto' resolves to 'all' for a single tree, else sqrt (classification) /
+    onethird (regression); an EXPLICIT strategy is honored even for one tree.
+    """
     if strategy == "auto":
-        strategy = "sqrt" if is_classifier else "onethird"
+        strategy = ("all" if n_trees == 1
+                    else "sqrt" if is_classifier else "onethird")
+    if strategy == "all":
+        return np.ones((n_trees, F), bool)
     k = {"sqrt": max(1, int(np.sqrt(F))),
          "log2": max(1, int(np.log2(F))),
          "onethird": max(1, F // 3)}.get(strategy)
@@ -357,7 +372,8 @@ class DecisionTreeRegressor(_TreeParams):
 @register_stage
 class RandomForestRegressor(_TreeParams):
     is_classifier = False
-    numTrees = IntParam("numTrees", "number of trees", 20)
+    numTrees = IntParam("numTrees", "number of trees", 20,
+                        validator=lambda v: v >= 1)
     featureSubsetStrategy = StringParam(
         "featureSubsetStrategy", "features considered per tree",
         "auto", domain=["auto", "all", "sqrt", "log2", "onethird"])
@@ -444,7 +460,8 @@ class _GBTBase(_TreeParams):
         def round_(Fcur):
             g, h = grad_fn(Fcur)
             feats, bins, leaf_V, leaf_w, node = grow_tree(
-                Xb_d, (-g)[:, None], h, ones_mask, depth, B, lam, min_w)
+                Xb_d, (-g)[:, None], h, ones_mask, depth, B, lam, min_w,
+                counts=jnp.ones_like(h))
             # Newton leaf: sum(-g)/(sum(h)+lam)
             value = leaf_V[:, 0] / (leaf_w + lam)
             Fnew = Fcur + self.stepSize * value[node]
