@@ -1,0 +1,181 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The long-context capability the task brief makes first-class (the reference
+predates attention entirely — SURVEY.md §5 "Long-context / sequence
+parallelism: absent"), built the TPU way:
+
+- **Ring attention** (`ring_attention`): Q stays put; K/V blocks rotate
+  around the ``seq`` mesh axis via ``ppermute`` (one ICI hop per step) while
+  each device accumulates its queries' attention with the online-softmax
+  (flash) recurrence. Peak memory per device is O(L_local^2) and the K/V
+  transfer overlaps compute on real ICI. Blockwise-parallel-transformer /
+  RingAttention pattern (Liu et al. 2023), PAPERS.md.
+- **Ulysses** (`ulysses_attention`): two ``all_to_all``s swap the sharded
+  axis sequence<->heads so each device computes FULL-sequence attention for
+  a head subset. Cheaper at moderate L (2 collectives instead of S ppermute
+  steps) but requires heads % seq_axis_size == 0.
+
+Both are drop-in ``attention_fn`` implementations for
+``models/zoo/transformer.py`` and differentiate through ``shard_map``
+(ppermute's transpose is the reverse ppermute, so the backward pass is a
+ring in the opposite direction — no custom VJP needed).
+
+Shapes follow the framework convention (B, L, H, D) with L sharded over the
+``seq`` axis at the boundary (``sharding.batch_sharding(seq_axis=...)``).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+BATCH_AXES = ("data", "fsdp")
+
+
+def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   causal: bool = True) -> jnp.ndarray:
+    """Plain softmax attention (B, L, H, D) — the single-device reference.
+
+    Matmuls run in the input dtype (bf16 tiles the MXU); scores, softmax and
+    the output accumulation are fp32, cast back once at the end.
+    """
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("blhd,bkhd->bhlk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        L, K = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(K)[None, :] > jnp.arange(L)[:, None]
+        s = jnp.where(mask, -jnp.inf, s)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhlk,bkhd->blhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
+    """Per-device body: accumulate over rotating K/V blocks (online softmax)."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    scale = 1.0 / np.sqrt(D)
+    q_pos = my_idx * Lq + jnp.arange(Lq)                   # global positions
+
+    def step(carry, i):
+        # accumulators (o, m, l) live in fp32 — bf16 rounding would compound
+        # across ring steps (flash-attention convention); k/v stay in the
+        # input dtype so the rotating transfers and matmuls remain cheap
+        o, m, l, k, v = carry
+        owner = (my_idx - i) % axis_size                   # whose block is here
+        s = jnp.einsum("blhd,bkhd->bhlk", q, k,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = owner * Lk + jnp.arange(Lk)
+            mask = k_pos[None, :] > q_pos[:, None]          # (Lq, Lk)
+            s = jnp.where(mask[None, None], -jnp.inf, s)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # exp(-inf - -inf) guard: fully-masked rows keep m=-inf, p=0
+        p = jnp.exp(s - jnp.where(jnp.isinf(m_new), 0.0, m_new)[..., None])
+        p = jnp.where(jnp.isinf(s), 0.0, p)
+        corr = jnp.exp(jnp.where(jnp.isinf(m), 0.0, m)
+                       - jnp.where(jnp.isinf(m_new), 0.0, m_new))
+        corr = jnp.where(jnp.isinf(m), 0.0, corr)
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = (o * corr[..., None]
+                 + jnp.einsum("bhlk,bkhd->bhld", p.astype(v.dtype), v,
+                              preferred_element_type=jnp.float32))
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        return (o_new, m_new, l_new, k, v), None
+
+    o0 = jnp.zeros((B, H, Lq, D), jnp.float32)
+    m0 = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Lq), jnp.float32)
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(axis_size))
+    out = o / jnp.maximum(l, 1e-30)[..., None]             # (B,H,Lq,D)
+    return out.astype(q.dtype).transpose(0, 2, 1, 3)       # (B,Lq,H,D)
+
+
+def _qkv_spec(mesh: Mesh, seq_axis: str, n_heads: int) -> P:
+    """(B, L, H, D) spec: batch over data axes, L over seq, and — when the
+    head count divides it — H over ``tensor``, so a tp x sp mesh keeps the
+    tensor-sharded qkv projections sharded through attention instead of
+    all-gathering and redundantly computing every head per tensor shard."""
+    batch = tuple(a for a in BATCH_AXES if mesh.shape.get(a, 1) > 1) or None
+    t = mesh.shape.get("tensor", 1)
+    head = "tensor" if t > 1 and n_heads % t == 0 else None
+    return P(batch, seq_axis, head, None)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   mesh: Mesh, seq_axis: str = "seq",
+                   causal: bool = True) -> jnp.ndarray:
+    """Context-parallel attention; (B, L, H, D) with L sharded over seq_axis."""
+    if mesh.shape.get(seq_axis, 1) == 1:
+        return full_attention(q, k, v, causal)
+    spec = _qkv_spec(mesh, seq_axis, q.shape[2])
+    fn = shard_map(
+        partial(_ring_attention_local, axis_name=seq_axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+def _ulysses_local(q, k, v, axis_name: str, causal: bool):
+    """all_to_all seq<->heads, full-sequence attention on a head subset."""
+    a2a = partial(jax.lax.all_to_all, axis_name=axis_name, tiled=True)
+    # (B, L/s, H, D) -> (B, L, H/s, D): gather sequence, scatter heads
+    q, k, v = (a2a(x, split_axis=2, concat_axis=1) for x in (q, k, v))
+    o = full_attention(q, k, v, causal)
+    # back: (B, L, H/s, D) -> (B, L/s, H, D)
+    return a2a(o, split_axis=1, concat_axis=2)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      mesh: Mesh, seq_axis: str = "seq",
+                      causal: bool = True) -> jnp.ndarray:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses pattern).
+
+    Requires n_heads divisible by the seq axis size.
+    """
+    s = mesh.shape.get(seq_axis, 1)
+    if s == 1:
+        return full_attention(q, k, v, causal)
+    spec = _qkv_spec(mesh, seq_axis, q.shape[2])
+    # the all_to_all splits the LOCAL head count (after any tensor sharding)
+    local_heads = q.shape[2] // (mesh.shape.get("tensor", 1)
+                                 if spec[2] == "tensor" else 1)
+    if local_heads % s:
+        raise ValueError(
+            f"ulysses needs per-shard heads ({local_heads}) divisible by "
+            f"|{seq_axis}|={s}")
+    fn = shard_map(
+        partial(_ulysses_local, axis_name=seq_axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def make_attention_fn(mesh: Optional[Mesh], impl: str = "auto",
+                      seq_axis: str = "seq"):
+    """attention_fn factory for TransformerLM: 'full' | 'ring' | 'ulysses' |
+    'auto' (ring when the mesh has a non-trivial seq axis)."""
+    if impl == "auto":
+        impl = ("ring" if mesh is not None
+                and mesh.shape.get(seq_axis, 1) > 1 else "full")
+    if impl == "full":
+        return full_attention
+    if impl == "ring":
+        return partial(ring_attention, mesh=mesh, seq_axis=seq_axis)
+    if impl == "ulysses":
+        return partial(ulysses_attention, mesh=mesh, seq_axis=seq_axis)
+    raise ValueError(f"unknown attention impl {impl!r}")

@@ -27,7 +27,10 @@ from mmlspark_tpu.testing.datagen import generate_frame
 for _m in pkgutil.walk_packages(mmlspark_tpu.__path__, "mmlspark_tpu."):
     importlib.import_module(_m.name)
 
-ALL_STAGES = registered_stages()
+# only stages shipped by the package: test modules may register their own
+# throwaway stages (e.g. test_core's Doubler) in the shared process
+ALL_STAGES = {q: c for q, c in registered_stages().items()
+              if q.startswith("mmlspark_tpu.")}
 
 
 # ---------------------------------------------------------------------------
