@@ -34,7 +34,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-BATCH_AXES = ("data", "fsdp")
+from mmlspark_tpu.parallel.sharding import active_batch_axes
 
 
 def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -109,7 +109,7 @@ def _qkv_spec(mesh: Mesh, seq_axis: str, n_heads: int) -> P:
     head count divides it — H over ``tensor``, so a tp x sp mesh keeps the
     tensor-sharded qkv projections sharded through attention instead of
     all-gathering and redundantly computing every head per tensor shard."""
-    batch = tuple(a for a in BATCH_AXES if mesh.shape.get(a, 1) > 1) or None
+    batch = active_batch_axes(mesh)
     t = mesh.shape.get("tensor", 1)
     head = "tensor" if t > 1 and n_heads % t == 0 else None
     return P(batch, seq_axis, head, None)

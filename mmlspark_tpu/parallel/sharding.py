@@ -93,11 +93,25 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_sharding(mesh: Mesh, batch_axes: Sequence[str] = ("data", "fsdp"),
+BATCH_AXES = ("data", "fsdp")
+
+
+def active_batch_axes(mesh: Mesh,
+                      batch_axes: Sequence[str] = BATCH_AXES):
+    """The non-trivial data-parallel axes of this mesh (None if all size 1).
+
+    THE single definition of which axes shard the batch dimension — the
+    sequence- and pipeline-parallel modules build their shard_map specs from
+    this too, so the policy can't drift between modules.
+    """
+    return tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+
+
+def batch_sharding(mesh: Mesh, batch_axes: Sequence[str] = BATCH_AXES,
                    seq_axis: Optional[str] = None) -> NamedSharding:
     """Batch dim sharded over the data-parallel axes; optionally the second
     (sequence) dim over `seq` for context parallelism."""
-    axes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    axes = active_batch_axes(mesh, batch_axes)
     if seq_axis and mesh.shape.get(seq_axis, 1) > 1:
         return NamedSharding(mesh, P(axes, seq_axis))
     return NamedSharding(mesh, P(axes))
