@@ -1,0 +1,97 @@
+"""Mid-training checkpoint / resume for sharded train state.
+
+The reference has NO mid-training checkpointing (SURVEY.md §5: CNTK owns it
+internally; the framework only persists fitted models). Here it is a
+first-class capability: the sharded state pytree (params + optimizer state +
+step) saves through orbax — each host writes its own shards, restore places
+shards directly onto the mesh via the trainer's NamedShardings, so neither
+direction ever materializes the full state on one host.
+
+Usage::
+
+    ckpt = TrainCheckpointer(dir, max_to_keep=3)
+    state, resumed = ckpt.restore_or_init(trainer, init_params_fn)
+    for step, batch in enumerate(batches, start=start_step + 1):
+        state, metrics = trainer.train_step(state, trainer.put_batch(batch), rng)
+        ckpt.maybe_save(state, every=100, step=step)
+    ckpt.save(state, wait=True)
+
+Elastic restart = rerun the same program: ``restore_or_init`` picks up the
+latest step and training continues bit-identically (fold_in(step) keys).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+
+
+class TrainCheckpointer:
+    """Orbax-backed checkpoint manager for DistributedTrainer state."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True))
+
+    # -- write --------------------------------------------------------------
+    def save(self, state: Any, step: Optional[int] = None,
+             wait: bool = False) -> int:
+        """Save (async by default); step defaults to state['step']."""
+        if step is None:
+            step = int(jax.device_get(state["step"]))
+        self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+        return step
+
+    def maybe_save(self, state: Any, every: int, step: int,
+                   wait: bool = False) -> Optional[int]:
+        """Save when ``step`` (the HOST loop counter — passing it avoids a
+        device sync per step) is a positive multiple of ``every``."""
+        if every > 0 and step > 0 and step % every == 0:
+            return self.save(state, step=step, wait=wait)
+        return None
+
+    # -- read ---------------------------------------------------------------
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def restore(self, trainer, init_params_fn: Callable[[], Any],
+                step: Optional[int] = None) -> Any:
+        """Restore ``step`` (default latest) directly into the trainer's
+        shardings; no full-state host copy."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}")
+        abstract, shardings = trainer.abstract_state(init_params_fn)
+        target = jax.tree_util.tree_map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            abstract, shardings)
+        with trainer.mesh:
+            return self._mgr.restore(
+                step, args=self._ocp.args.StandardRestore(target))
+
+    def restore_or_init(self, trainer, init_params_fn: Callable[[], Any]
+                        ) -> Tuple[Any, bool]:
+        """(state, resumed): latest checkpoint if one exists, else fresh init.
+
+        Either way the trainer's sharding spec is established, so
+        ``train_step`` works immediately after.
+        """
+        if self.latest_step() is None:
+            return trainer.init(init_params_fn), False
+        return self.restore(trainer, init_params_fn), True
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

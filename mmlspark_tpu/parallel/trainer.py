@@ -56,19 +56,32 @@ class DistributedTrainer:
         self._eval_step = None
 
     # -- state -------------------------------------------------------------
-    def init(self, init_params_fn: Callable[[], Any]) -> Dict[str, Any]:
-        """Initialize sharded state; params materialize directly into their
-        shards (no host-side full copy on any single device)."""
+    def _full_init_fn(self, init_params_fn: Callable[[], Any]):
         def full_init():
             params = init_params_fn()
             return {"params": params,
                     "opt_state": self.optimizer.init(params),
                     "step": jnp.zeros((), jnp.int32)}
+        return full_init
 
+    def _abstract_state(self, full_init):
         abstract = jax.eval_shape(full_init)
         # Optimizer state mirrors the param tree (adam mu/nu paths contain the
         # same leaf names), so one rule pass shards params AND opt state.
         self._state_shardings = param_shardings(abstract, self.mesh, self.rules)
+        return abstract, self._state_shardings
+
+    def abstract_state(self, init_params_fn: Callable[[], Any]):
+        """(abstract shapes, shardings) of the train state WITHOUT
+        materializing it — the checkpoint-restore target (checkpoint.py).
+        Also establishes this trainer's sharding spec."""
+        return self._abstract_state(self._full_init_fn(init_params_fn))
+
+    def init(self, init_params_fn: Callable[[], Any]) -> Dict[str, Any]:
+        """Initialize sharded state; params materialize directly into their
+        shards (no host-side full copy on any single device)."""
+        full_init = self._full_init_fn(init_params_fn)
+        self._abstract_state(full_init)
         with self.mesh:
             return jax.jit(full_init, out_shardings=self._state_shardings)()
 

@@ -1,0 +1,130 @@
+"""Mid-training checkpoint/resume tests (capability beyond the reference,
+which has none — SURVEY.md §5)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from mmlspark_tpu.parallel.checkpoint import TrainCheckpointer
+from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+from mmlspark_tpu.parallel.trainer import DistributedTrainer
+
+DIM = 8
+
+
+def _make_trainer():
+    mesh = make_mesh(MeshSpec(data=4, tensor=2))
+
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return ((pred - batch["y"]) ** 2).mean()
+
+    return DistributedTrainer(loss_fn, optax.adam(1e-2), mesh=mesh)
+
+
+def _init_params():
+    return {"w": jnp.ones((DIM, DIM), jnp.float32) * 0.1,
+            "b": jnp.zeros((DIM,), jnp.float32)}
+
+
+def _batch(i):
+    rng = np.random.default_rng(i)
+    x = rng.normal(0, 1, (16, DIM)).astype(np.float32)
+    return {"x": x, "y": (x * 0.5).astype(np.float32)}
+
+
+def _run_steps(trainer, state, start, n):
+    for i in range(start, start + n):
+        state, _ = trainer.train_step(
+            state, trainer.put_batch(_batch(i)), jax.random.PRNGKey(0))
+    return state
+
+
+def _tree_equal(a, b):
+    fa, ta = jax.tree_util.tree_flatten(jax.device_get(a))
+    fb, tb = jax.tree_util.tree_flatten(jax.device_get(b))
+    assert ta == tb, f"tree structure differs: {ta} vs {tb}"
+    return all(np.array_equal(x, y) for x, y in zip(fa, fb))
+
+
+def test_save_restore_roundtrip(tmp_path):
+    trainer = _make_trainer()
+    state = _run_steps(trainer, trainer.init(_init_params), 0, 3)
+    ckpt = TrainCheckpointer(str(tmp_path / "ck"))
+    step = ckpt.save(state, wait=True)
+    assert step == 3
+    assert ckpt.latest_step() == 3
+
+    trainer2 = _make_trainer()
+    restored = ckpt.restore(trainer2, _init_params)
+    assert _tree_equal(state, restored)
+    ckpt.close()
+
+
+def test_resume_is_bit_identical_to_uninterrupted_run(tmp_path):
+    # uninterrupted: 5 steps
+    t_full = _make_trainer()
+    s_full = _run_steps(t_full, t_full.init(_init_params), 0, 5)
+
+    # interrupted: 3 steps -> save -> fresh process-equivalent -> 2 more
+    t_a = _make_trainer()
+    s_a = _run_steps(t_a, t_a.init(_init_params), 0, 3)
+    ckpt = TrainCheckpointer(str(tmp_path / "ck"))
+    ckpt.save(s_a, wait=True)
+
+    t_b = _make_trainer()
+    s_b, resumed = TrainCheckpointer(str(tmp_path / "ck")).restore_or_init(
+        t_b, _init_params)
+    assert resumed
+    assert int(jax.device_get(s_b["step"])) == 3
+    s_b = _run_steps(t_b, s_b, 3, 2)
+    assert _tree_equal(s_full, s_b)
+    ckpt.close()
+
+
+def test_restore_or_init_fresh(tmp_path):
+    trainer = _make_trainer()
+    state, resumed = TrainCheckpointer(str(tmp_path / "ck")).restore_or_init(
+        trainer, _init_params)
+    assert not resumed
+    assert int(jax.device_get(state["step"])) == 0
+    # trainer is immediately usable (shardings established)
+    _run_steps(trainer, state, 0, 1)
+
+
+def test_maybe_save_interval_and_retention(tmp_path):
+    trainer = _make_trainer()
+    state = trainer.init(_init_params)
+    ckpt = TrainCheckpointer(str(tmp_path / "ck"), max_to_keep=2)
+    for i in range(6):
+        state, _ = trainer.train_step(
+            state, trainer.put_batch(_batch(i)), jax.random.PRNGKey(0))
+        ckpt.maybe_save(state, every=2, step=i + 1, wait=True)
+    assert ckpt.latest_step() == 6
+    assert ckpt.all_steps() == [4, 6]  # max_to_keep=2 pruned step 2
+    ckpt.close()
+
+
+def test_restore_missing_checkpoint_raises(tmp_path):
+    trainer = _make_trainer()
+    with pytest.raises(FileNotFoundError):
+        TrainCheckpointer(str(tmp_path / "empty")).restore(
+            trainer, _init_params)
+
+
+def test_restored_shardings_match_trainer_spec(tmp_path):
+    trainer = _make_trainer()
+    state = trainer.init(_init_params)
+    ckpt = TrainCheckpointer(str(tmp_path / "ck"))
+    ckpt.save(state, wait=True)
+    trainer2 = _make_trainer()
+    restored = ckpt.restore(trainer2, _init_params)
+    spec = trainer2.state_sharding_spec()
+    got_sh = jax.tree_util.tree_map(lambda a: a.sharding, restored)
+    want = jax.tree_util.tree_leaves(
+        spec, is_leaf=lambda x: hasattr(x, "spec"))
+    got = jax.tree_util.tree_leaves(
+        got_sh, is_leaf=lambda x: hasattr(x, "spec"))
+    assert [s.spec for s in want] == [s.spec for s in got]
+    ckpt.close()
