@@ -1,0 +1,55 @@
+"""Codegen tests: API reference freshness + the generated per-stage suite.
+
+Counterpart of the reference's generated-wrapper test pipeline
+(``codegen/src/main/scala/PySparkWrapperTest.scala`` + ``tools/pytests``).
+"""
+import os
+
+import pytest
+
+from mmlspark_tpu.codegen.generate import (
+    all_stages, generate_api_reference, generate_stage_test_source,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_api_reference_is_fresh():
+    """docs/API.md must match a regeneration — stale docs fail CI, the same
+    forcing function the reference gets from codegen-in-the-build."""
+    path = os.path.join(REPO, "docs", "API.md")
+    assert os.path.exists(path), "docs/API.md missing: run " \
+        "`python -m mmlspark_tpu.codegen.generate docs/API.md`"
+    with open(path) as f:
+        on_disk = f.read()
+    assert on_disk == generate_api_reference(), (
+        "docs/API.md is stale: run "
+        "`python -m mmlspark_tpu.codegen.generate docs/API.md`")
+
+
+def test_api_reference_mentions_every_stage():
+    ref = generate_api_reference()
+    for qualname in all_stages():
+        name = qualname.rsplit(".", 1)[1]
+        assert f"### {name} (" in ref, f"{name} missing from API reference"
+
+
+def _generated_namespace():
+    src = generate_stage_test_source()
+    ns = {}
+    exec(compile(src, "<generated_stage_tests>", "exec"), ns)
+    return ns
+
+
+def test_generated_suite_covers_every_stage():
+    ns = _generated_namespace()
+    tests = [k for k in ns if k.startswith("test_generated_")]
+    assert len(tests) == len(all_stages())
+
+
+@pytest.mark.parametrize("name", sorted(
+    k for k in _generated_namespace() if k.startswith("test_generated_")))
+def test_generated(name):
+    """Run each generated per-stage smoke test."""
+    ns = _generated_namespace()
+    ns[name]()
