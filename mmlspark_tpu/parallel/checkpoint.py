@@ -11,6 +11,7 @@ Usage::
 
     ckpt = TrainCheckpointer(dir, max_to_keep=3)
     state, resumed = ckpt.restore_or_init(trainer, init_params_fn)
+    start_step = ckpt.latest_step() or 0
     for step, batch in enumerate(batches, start=start_step + 1):
         state, metrics = trainer.train_step(state, trainer.put_batch(batch), rng)
         ckpt.maybe_save(state, every=100, step=step)
