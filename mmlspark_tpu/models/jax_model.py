@@ -76,17 +76,29 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         params = jax.tree_util.tree_map(jnp.asarray, self._state["params"])
         node = self.outputNodeName
 
+        # Optional input standardization: models trained on z-scored inputs
+        # (e.g. DeepClassifier) carry fit-time statistics so extraction sees
+        # the same distribution the net was trained on. Shapes must broadcast
+        # against the model input shape.
+        mu = self._state.get("input_mu")
+        if mu is not None:
+            mu_d = jnp.asarray(mu)
+            sigma_d = jnp.asarray(self._state["input_sigma"])
+            pre = lambda x: (x - mu_d) / sigma_d
+        else:
+            pre = lambda x: x
+
         if not node:
             @jax.jit
             def apply(x):
-                return module.apply(params, x)
+                return module.apply(params, pre(x))
             return apply, None
 
         from mmlspark_tpu.models.zoo.resnet import apply_with_intermediates
 
         @jax.jit
         def apply(x):
-            _, inters = apply_with_intermediates(module, params, x)
+            _, inters = apply_with_intermediates(module, params, pre(x))
             matches = [v for k, v in sorted(inters.items())
                        if k == node or k.endswith("/" + node)]
             if not matches:

@@ -1,0 +1,144 @@
+"""DeepClassifier: the CNTKLearner-equivalent distributed Estimator.
+
+Reference flow being matched: CNTKLearner.fit featurizes a DataFrame,
+launches distributed training, and returns a scoring CNTKModel
+(``cntk-train/src/main/scala/CNTKLearner.scala:52-162``). Here the judged
+config "TrainClassifier DNN on Adult Census — data-parallel over ICI"
+(BASELINE.json configs[2]) runs end-to-end through the pipeline API over
+the 8-device CPU mesh.
+"""
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.schema import ScoreKind, find_score_column
+from mmlspark_tpu.core.serialization import load_stage, save_stage
+from mmlspark_tpu.evaluate.compute_model_statistics import ComputeModelStatistics
+from mmlspark_tpu.parallel.mesh import MeshSpec
+from mmlspark_tpu.train.deep import DeepClassifier, DeepClassifierModel
+from mmlspark_tpu.train.train_classifier import TrainClassifier
+
+from tests.test_train import make_census_like
+
+
+def _deep_learner(**kw):
+    kw.setdefault("architecture", "mlp_tabular")
+    kw.setdefault("architectureArgs", {"hidden": [32]})
+    kw.setdefault("batchSize", 64)
+    kw.setdefault("epochs", 30)
+    kw.setdefault("learningRate", 3e-3)
+    return DeepClassifier(**kw)
+
+
+def test_deep_classifier_through_train_classifier_data_parallel():
+    """The flagship judged config: deep net, data-parallel over the mesh,
+    driven entirely through the TrainClassifier pipeline surface."""
+    frame = make_census_like()
+    learner = _deep_learner(meshSpec=MeshSpec(data=-1))  # all 8 devices on data
+    model = TrainClassifier(model=learner, labelCol="income").fit(frame)
+    scored = model.transform(frame)
+    assert find_score_column(scored.schema, ScoreKind.SCORED_LABELS) \
+        == "scored_labels"
+    metrics = ComputeModelStatistics().transform(scored).collect()
+    assert metrics["accuracy"][0] > 0.8
+    assert metrics["AUC"][0] > 0.85
+
+
+def test_deep_classifier_tensor_and_fsdp_mesh():
+    """Same estimator, nontrivial tensor x fsdp x data mesh — the sharding
+    rules must compile and converge identically in quality."""
+    frame = make_census_like()
+    learner = _deep_learner(
+        meshSpec={"data": 2, "fsdp": 2, "tensor": 2}, epochs=20)
+    model = TrainClassifier(model=learner, labelCol="income").fit(frame)
+    metrics = ComputeModelStatistics().transform(
+        model.transform(frame)).collect()
+    assert metrics["accuracy"][0] > 0.75
+
+
+def test_deep_classifier_direct_fit_padding_and_multibatch():
+    """Direct learner fit on a pre-featurized frame: row count NOT divisible
+    by batch size exercises the pad+mask tail path; frame >> batch exercises
+    multi-step streaming."""
+    from mmlspark_tpu.core.frame import Frame
+    rng = np.random.default_rng(1)
+    n, d = 333, 10
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d,))
+    y = (X @ w > 0).astype(np.int64)
+    frame = Frame.from_dict({"features": X, "label": y})
+    learner = _deep_learner(batchSize=32, epochs=40)
+    learner.set_params(featuresCol="features", labelCol="label")
+    model = learner.fit(frame)
+    scored = model.transform(frame)
+    pred = scored.column("prediction").astype(int)
+    assert (pred == y).mean() > 0.9
+    assert len(pred) == n  # tail rows present exactly once
+
+
+def test_deep_classifier_model_save_load_roundtrip(tmp_path):
+    from mmlspark_tpu.core.frame import Frame
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(96, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64)
+    frame = Frame.from_dict({"features": X, "label": y})
+    learner = _deep_learner(batchSize=32, epochs=10)
+    learner.set_params(featuresCol="features", labelCol="label")
+    model = learner.fit(frame)
+    p1 = model.transform(frame).column("prediction")
+
+    path = str(tmp_path / "deep_model")
+    save_stage(model, path)
+    loaded = load_stage(path)
+    assert isinstance(loaded, DeepClassifierModel)
+    p2 = loaded.transform(frame).column("prediction")
+    np.testing.assert_array_equal(p1, p2)
+
+
+def test_deep_classifier_checkpoint_resume(tmp_path):
+    """Elastic restart: kill after a partial fit, refit with the same
+    checkpointDir — training resumes from the saved step, not step 0."""
+    from mmlspark_tpu.core.frame import Frame
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(128, 6)).astype(np.float32)
+    y = (X[:, 1] > 0).astype(np.int64)
+    frame = Frame.from_dict({"features": X, "label": y})
+    ckdir = str(tmp_path / "ck")
+
+    first = _deep_learner(batchSize=32, epochs=3, checkpointDir=ckdir,
+                          checkpointEvery=1)
+    first.set_params(featuresCol="features", labelCol="label")
+    first.fit(frame)
+
+    from mmlspark_tpu.parallel.checkpoint import TrainCheckpointer
+    saved_step = TrainCheckpointer(ckdir).latest_step()
+    assert saved_step == 12  # 4 steps/epoch x 3 epochs
+
+    # Re-fit with more epochs: must resume past the saved step and extend.
+    second = _deep_learner(batchSize=32, epochs=5, checkpointDir=ckdir,
+                           checkpointEvery=1)
+    second.set_params(featuresCol="features", labelCol="label")
+    second.fit(frame)
+    assert TrainCheckpointer(ckdir).latest_step() == 20
+
+
+def test_deep_classifier_to_jax_model_feature_extraction():
+    from mmlspark_tpu.core.frame import Frame
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(64, 8)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64)
+    frame = Frame.from_dict({"features": X, "label": y})
+    learner = _deep_learner(batchSize=32, epochs=5,
+                            architectureArgs={"hidden": [16]})
+    learner.set_params(featuresCol="features", labelCol="label")
+    model = learner.fit(frame)
+    jm = model.to_jax_model(output_node="pool", mini_batch_size=32)
+    feats = jm.transform(frame)
+    F = feats.column("features")
+    assert F.shape == (64, 16)
+    # The extracted features must be the SAME activations scoring sees:
+    # head(features) == the model's own logits (standardization included).
+    head = model._state["params"]["params"]["head"]
+    logits_from_feats = F @ np.asarray(head["kernel"]) + np.asarray(head["bias"])
+    logits, _ = model._cached_jit(model.scores_fn)(X)
+    np.testing.assert_allclose(logits_from_feats, np.asarray(logits),
+                               rtol=1e-4, atol=1e-4)

@@ -166,6 +166,10 @@ def _configs():
                                           kinds=["double", "float", "int"],
                                           with_label="real")),
         "LogisticRegression": (_lr, _features_frame),
+        "DeepClassifier": (lambda: __import__(
+            "mmlspark_tpu.train.deep", fromlist=["DeepClassifier"])
+            .DeepClassifier(architectureArgs={"hidden": [8]}, batchSize=16,
+                            epochs=2), _features_frame),
         "MLPClassifier": (lambda: MLPClassifier(maxIter=10, layers=[8]),
                           _features_frame),
         "NaiveBayes": (lambda: NaiveBayes(), _features_frame),
@@ -231,6 +235,7 @@ EXCLUDED = {
     "TreeClassifierModel": "model of DecisionTree/RandomForestClassifier",
     "TreeRegressorModel": "model of tree regressors",
     "GBTClassifierModel": "model of GBTClassifier",
+    "DeepClassifierModel": "model of DeepClassifier",
     "TrainedClassifierModel": "model of TrainClassifier",
     "TrainedRegressorModel": "model of TrainRegressor",
     "BestModel": "model of FindBestModel",
