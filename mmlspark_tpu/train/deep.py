@@ -121,46 +121,15 @@ class DeepClassifier(JaxEstimator):
     logEvery = IntParam("logEvery", "log train metrics every N steps (0=off)", 0)
 
     # -- data streaming ----------------------------------------------------
-    def _stats_pass(self, frame: Frame, fcol: str, lcol: str,
-                    bs: int) -> Tuple[int, int, np.ndarray, np.ndarray, int]:
-        """One streaming pass: n_rows, input_dim, mean, std, max label."""
-        n, d = 0, None
-        s = ss = None
-        ymax = 0
-        for hb in frame.batches(bs, cols=[fcol, lcol]):
-            x = np.asarray(hb[fcol], dtype=np.float64)
-            if x.ndim != 2:
-                raise ValueError(
-                    f"features column {fcol!r} must be a vector column")
-            if d is None:
-                d = x.shape[1]
-                s = np.zeros(d)
-                ss = np.zeros(d)
-            n += x.shape[0]
-            s += x.sum(axis=0)
-            ss += (x * x).sum(axis=0)
-            y = np.asarray(hb[lcol])
-            if len(y):
-                ymax = max(ymax, int(y.max()))
-        if n == 0:
-            raise ValueError("DeepClassifier: empty frame")
-        mu = s / n
-        var = np.maximum(ss / n - mu * mu, 0.0)
-        sigma = np.sqrt(var) + 1e-6
-        return n, d, mu.astype(np.float32), sigma.astype(np.float32), ymax
-
+    # Stats and padding come from JaxEstimator._streaming_stats / _pad_xyw
+    # (learners.py) — one implementation of the streaming moment pass and the
+    # pad-and-mask batch builder shared by every streaming learner.
     @staticmethod
     def _pad_batch(hb: Dict[str, np.ndarray], fcol: str, lcol: str,
                    bs: int) -> Dict[str, np.ndarray]:
         """Fixed-shape training batch: zero-pad the tail, mask it via `w`."""
-        x = np.asarray(hb[fcol], dtype=np.float32)
-        y = np.asarray(hb[lcol]).astype(np.int32)
-        k = x.shape[0]
-        w = np.ones((bs,), np.float32)
-        if k < bs:
-            x = np.concatenate([x, np.zeros((bs - k,) + x.shape[1:], x.dtype)])
-            y = np.concatenate([y, np.zeros((bs - k,), y.dtype)])
-            w[k:] = 0.0
+        from mmlspark_tpu.train.learners import _pad_xyw
+        x, y, w = _pad_xyw(hb, fcol, lcol, bs, np.int32)
         return {"x": x, "y": y, "w": w}
 
     # -- fit ---------------------------------------------------------------
@@ -177,11 +146,8 @@ class DeepClassifier(JaxEstimator):
         quantum = dp * self.accumSteps
         bs = int(math.ceil(self.batchSize / quantum) * quantum)
 
-        n, d, mu, sigma, ymax = self._stats_pass(frame, fcol, lcol, bs)
-        n_classes = max(ymax + 1, 2)
-        cmap = frame.schema[lcol].categorical
-        if cmap is not None:
-            n_classes = max(n_classes, cmap.num_levels)
+        n, d, mu, sigma, ymax, _, _ = self._streaming_stats(frame)
+        n_classes = self._num_classes(frame, ymax)
 
         spec, resolved_args = _build_spec(
             self.architecture, self.get("architectureArgs"), d, n_classes)

@@ -43,8 +43,22 @@ class FeaturizeHints:
         self.num_features = num_features
 
 
+class HasBatchSize:
+    """Mixin for learners that stream minibatches (trees don't: histogram
+    CART materializes the binned dataset by construction)."""
+    batchSize = IntParam("batchSize", "minibatch rows per optimizer step",
+                         8192, validator=lambda v: v > 0)
+
+
 class JaxEstimator(HasFeaturesCol, HasLabelCol, Estimator):
-    """Base: pulls (X, y) host arrays from the frame, hands them to _train."""
+    """Base for JAX learners: streaming stats + minibatch fit helpers.
+
+    Iterative learners train in O(batch) device memory: one jitted step at a
+    single compiled shape, tail batches zero-padded and masked by a per-row
+    weight (the reference's pad-and-drop workaround ``CNTKModel.scala:71-76``
+    done the XLA way). Tree learners (`train/trees.py`) still materialize the
+    dataset — histogram CART needs global quantile bins by construction.
+    """
 
     hints = FeaturizeHints()
     is_classifier = True
@@ -57,41 +71,136 @@ class JaxEstimator(HasFeaturesCol, HasLabelCol, Estimator):
         y = np.asarray(frame.column(self.labelCol))
         return X, y
 
-    def _num_classes(self, frame: Frame, y: np.ndarray) -> int:
+    def _peek_dim(self, frame: Frame) -> int:
+        """Feature-vector width from the first row only (no data scan)."""
+        for hb in frame.batches(1, cols=[self.featuresCol]):
+            x = np.asarray(hb[self.featuresCol])
+            if x.ndim != 2:
+                raise ValueError(f"features column {self.featuresCol!r} must "
+                                 "be a vector column")
+            return x.shape[1]
+        raise ValueError(f"{type(self).__name__}: empty frame")
+
+    def _label_max(self, frame: Frame) -> int:
+        """Max label value, streaming the label column only."""
+        ymax = -1
+        for hb in frame.batches(1 << 18, cols=[self.labelCol]):
+            y = np.asarray(hb[self.labelCol])
+            if len(y):
+                ymax = max(ymax, int(y.max()))
+        if ymax < 0:
+            raise ValueError(f"{type(self).__name__}: empty frame")
+        return ymax
+
+    def _streaming_stats(self, frame: Frame):
+        """One streaming pass over (features, label):
+        (n, d, mu, sigma, ymax, ymu, ysigma)."""
+        fcol, lcol = self.featuresCol, self.labelCol
+        bs = self.get("batchSize") if any(
+            p.name == "batchSize" for p in self.params()) else 1 << 16
+        n, d = 0, None
+        s = ss = None
+        ymax, ysum, ysumsq = 0, 0.0, 0.0
+        for hb in frame.batches(bs, cols=[fcol, lcol]):
+            x = np.asarray(hb[fcol], dtype=np.float64)
+            if x.ndim != 2:
+                raise ValueError(
+                    f"features column {fcol!r} must be a vector column")
+            if d is None:
+                d = x.shape[1]
+                s, ss = np.zeros(d), np.zeros(d)
+            n += x.shape[0]
+            s += x.sum(axis=0)
+            ss += (x * x).sum(axis=0)
+            y = np.asarray(hb[lcol], dtype=np.float64)
+            if len(y):
+                ymax = max(ymax, int(y.max()))
+                ysum += y.sum()
+                ysumsq += (y * y).sum()
+        if n == 0:
+            raise ValueError(f"{type(self).__name__}: empty frame")
+        mu = (s / n).astype(np.float32)
+        sigma = (np.sqrt(np.maximum(ss / n - (s / n) ** 2, 0.0)) + 1e-6
+                 ).astype(np.float32)
+        ymu = ysum / n
+        ysigma = float(np.sqrt(max(ysumsq / n - ymu * ymu, 0.0))) + 1e-6
+        return n, d, mu, sigma, ymax, float(ymu), ysigma
+
+    def _num_classes(self, frame: Frame, y) -> int:
         """Class count from the label column's level metadata when present —
-        rows of a class may have been dropped by NaN cleaning, so y.max()
-        alone can under-count."""
-        seen = int(y.max()) + 1 if len(y) else 2
+        rows of a class may have been dropped by NaN cleaning, so max(y)
+        alone can under-count. ``y`` is the max label (int) or a label array."""
+        if isinstance(y, np.ndarray):
+            y = int(y.max()) if len(y) else 1
+        seen = int(y) + 1
         cmap = frame.schema[self.labelCol].categorical
         if cmap is not None:
             seen = max(seen, cmap.num_levels)
         return max(seen, 2)
 
 
-def _full_batch_adam(loss_fn: Callable, params: Any, data: Tuple,
-                     lr: float, steps: int) -> Any:
-    """Full-batch Adam, the whole loop compiled as one XLA program."""
+def _pad_xyw(hb: Dict[str, np.ndarray], fcol: str, lcol: str, bs: int,
+             y_dtype) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Fixed-shape (x, y, w) batch: zero-pad the tail, mask it via w."""
+    x = np.asarray(hb[fcol], dtype=np.float32)
+    y = np.asarray(hb[lcol]).astype(y_dtype)
+    k = x.shape[0]
+    w = np.ones((bs,), np.float32)
+    if k < bs:
+        x = np.concatenate([x, np.zeros((bs - k,) + x.shape[1:], x.dtype)])
+        y = np.concatenate([y, np.zeros((bs - k,), y.dtype)])
+        w[k:] = 0.0
+    return x, y, w
+
+
+def _stream_adam(loss_fn: Callable, params: Any, frame: Frame,
+                 fcol: str, lcol: str, *, lr: float, max_steps: int,
+                 batch_size: int, y_dtype=np.int32) -> Any:
+    """Minibatch Adam streamed from the frame: ONE compiled step shape,
+    epochs cycled until ``max_steps`` optimizer steps have run.
+
+    ``loss_fn(params, x, y, w)`` must be a per-row-weighted loss. When the
+    whole frame fits in a single batch the padded device batch is kept
+    resident across steps (no host->HBM churn), which makes the small-data
+    case equivalent to the old full-batch loop.
+    """
     opt = optax.adam(lr)
     opt_state = opt.init(params)
-    grad_fn = jax.grad(loss_fn)
-
-    def body(_, carry):
-        p, s = carry
-        g = grad_fn(p, *data)
-        updates, s = opt.update(g, s, p)
-        return optax.apply_updates(p, updates), s
 
     @jax.jit
-    def run(params, opt_state):
-        return jax.lax.fori_loop(0, steps, body, (params, opt_state))
+    def step(p, s, x, y, w):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y, w)
+        updates, s = opt.update(g, s, p)
+        return optax.apply_updates(p, updates), s, loss
 
-    params, _ = run(params, opt_state)
+    steps = 0
+    resident = None  # device batch reused when the frame is one batch wide
+    while steps < max_steps:
+        if resident is not None:
+            params, opt_state, _ = step(params, opt_state, *resident)
+            steps += 1
+            continue
+        n_batches, first = 0, None
+        for hb in frame.batches(batch_size, cols=[fcol, lcol]):
+            dev = tuple(jax.device_put(a)
+                        for a in _pad_xyw(hb, fcol, lcol, batch_size, y_dtype))
+            n_batches += 1
+            if n_batches == 1:
+                first = dev
+            params, opt_state, _ = step(params, opt_state, *dev)
+            steps += 1
+            if steps >= max_steps:
+                break
+        if n_batches == 0:
+            raise ValueError("empty frame")
+        if n_batches == 1:
+            resident = first
     return params
 
 
 # --------------------------------------------------------------------------
 @register_stage
-class LogisticRegression(JaxEstimator):
+class LogisticRegression(HasBatchSize, JaxEstimator):
     """Multinomial logistic regression, full-batch Adam, L2 regularization."""
 
     maxIter = IntParam("maxIter", "number of optimizer steps", 200)
@@ -99,25 +208,24 @@ class LogisticRegression(JaxEstimator):
     learningRate = FloatParam("learningRate", "Adam learning rate", 0.1)
 
     def fit(self, frame: Frame) -> "LinearClassifierModel":
-        X, y = self._collect_xy(frame)
-        y = y.astype(np.int32)
-        n_classes = self._num_classes(frame, y)
-        d = X.shape[1]
-        mu, sigma = X.mean(axis=0), X.std(axis=0) + 1e-6
+        n, d, mu, sigma, ymax, _, _ = self._streaming_stats(frame)
+        n_classes = self._num_classes(frame, ymax)
 
         params = {"w": jnp.zeros((d, n_classes), jnp.float32),
                   "b": jnp.zeros((n_classes,), jnp.float32)}
-        Xd = (jnp.asarray(X) - mu) / sigma
-        yd = jnp.asarray(y)
         reg = self.regParam
+        mu_d, sigma_d = jnp.asarray(mu), jnp.asarray(sigma)
 
-        def loss(p, X, y):
-            logits = X @ p["w"] + p["b"]
-            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
-            return ce + reg * (p["w"] ** 2).sum()
+        def loss(p, X, y, w):
+            logits = ((X - mu_d) / sigma_d) @ p["w"] + p["b"]
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            return (ce * w).sum() / jnp.maximum(w.sum(), 1.0) \
+                + reg * (p["w"] ** 2).sum()
 
-        params = _full_batch_adam(loss, params, (Xd, yd),
-                                  self.learningRate, self.maxIter)
+        params = _stream_adam(loss, params, frame, self.featuresCol,
+                              self.labelCol, lr=self.learningRate,
+                              max_steps=self.maxIter,
+                              batch_size=self.batchSize)
         model = LinearClassifierModel(featuresCol=self.featuresCol,
                                       labelCol=self.labelCol)
         model._state = {"w": np.asarray(params["w"]), "b": np.asarray(params["b"]),
@@ -145,7 +253,7 @@ class LinearClassifierModel(HasFeaturesCol, HasLabelCol, Model):
 
 # --------------------------------------------------------------------------
 @register_stage
-class MLPClassifier(JaxEstimator):
+class MLPClassifier(HasBatchSize, JaxEstimator):
     """Multi-layer perceptron classifier (ReLU hidden layers, softmax head)."""
 
     hints = FeaturizeHints(one_hot=True, num_features=1 << 12)
@@ -156,11 +264,9 @@ class MLPClassifier(JaxEstimator):
     seed = IntParam("seed", "PRNG seed", 0)
 
     def fit(self, frame: Frame) -> "MLPClassifierModel":
-        X, y = self._collect_xy(frame)
-        y = y.astype(np.int32)
-        n_classes = self._num_classes(frame, y)
-        mu, sigma = X.mean(axis=0), X.std(axis=0) + 1e-6
-        sizes = [X.shape[1]] + [int(h) for h in self.layers] + [n_classes]
+        n, d, mu, sigma, ymax, _, _ = self._streaming_stats(frame)
+        n_classes = self._num_classes(frame, ymax)
+        sizes = [d] + [int(h) for h in self.layers] + [n_classes]
         key = jax.random.PRNGKey(self.seed)
         params = []
         for i in range(len(sizes) - 1):
@@ -170,19 +276,23 @@ class MLPClassifier(JaxEstimator):
                 "w": jax.random.normal(k, (sizes[i], sizes[i + 1]), jnp.float32) * scale,
                 "b": jnp.zeros((sizes[i + 1],), jnp.float32)})
 
+        mu_d, sigma_d = jnp.asarray(mu), jnp.asarray(sigma)
+
         def forward(p, X):
-            h = X
+            h = (X - mu_d) / sigma_d
             for layer in p[:-1]:
                 h = jax.nn.relu(h @ layer["w"] + layer["b"])
             return h @ p[-1]["w"] + p[-1]["b"]
 
-        def loss(p, X, y):
-            return optax.softmax_cross_entropy_with_integer_labels(
-                forward(p, X), y).mean()
+        def loss(p, X, y, w):
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                forward(p, X), y)
+            return (ce * w).sum() / jnp.maximum(w.sum(), 1.0)
 
-        Xd = (jnp.asarray(X) - mu) / sigma
-        params = _full_batch_adam(loss, params, (Xd, jnp.asarray(y)),
-                                  self.learningRate, self.maxIter)
+        params = _stream_adam(loss, params, frame, self.featuresCol,
+                              self.labelCol, lr=self.learningRate,
+                              max_steps=self.maxIter,
+                              batch_size=self.batchSize)
         model = MLPClassifierModel(featuresCol=self.featuresCol,
                                    labelCol=self.labelCol)
         model._state = {
@@ -215,7 +325,7 @@ class MLPClassifierModel(HasFeaturesCol, HasLabelCol, Model):
 
 # --------------------------------------------------------------------------
 @register_stage
-class NaiveBayes(JaxEstimator):
+class NaiveBayes(HasBatchSize, JaxEstimator):
     """Multinomial naive Bayes via one batched count matmul (non-negative
     features, e.g. hashed term counts / one-hots)."""
 
@@ -223,22 +333,37 @@ class NaiveBayes(JaxEstimator):
     smoothing = FloatParam("smoothing", "Laplace smoothing", 1.0)
 
     def fit(self, frame: Frame) -> "NaiveBayesModel":
-        X, y = self._collect_xy(frame)
-        y = y.astype(np.int32)
-        n_classes = self._num_classes(frame, y)
+        # d from the first row; class count from label metadata when present,
+        # else one cheap label-only pass — no full feature scan needed.
+        d = self._peek_dim(frame)
+        cmap = frame.schema[self.labelCol].categorical
+        ymax = (cmap.num_levels - 1) if cmap is not None \
+            else self._label_max(frame)
+        n_classes = self._num_classes(frame, ymax)
+        bs = self.batchSize
 
         @jax.jit
-        def train(X, y):
-            X = jnp.maximum(X, 0.0)
-            onehot = jax.nn.one_hot(y, n_classes, dtype=jnp.float32)  # (n, C)
-            counts = onehot.T @ X                                     # (C, d)
-            prior = onehot.sum(axis=0)
+        def accum(counts, prior, X, y, w):
+            onehot = jax.nn.one_hot(y, n_classes, dtype=jnp.float32) \
+                * w[:, None]                                          # (b, C)
+            return counts + onehot.T @ jnp.maximum(X, 0.0), \
+                prior + onehot.sum(axis=0)
+
+        counts = jnp.zeros((n_classes, d), jnp.float32)
+        prior = jnp.zeros((n_classes,), jnp.float32)
+        for hb in frame.batches(bs, cols=[self.featuresCol, self.labelCol]):
+            x, y, w = _pad_xyw(hb, self.featuresCol, self.labelCol, bs,
+                               np.int32)
+            counts, prior = accum(counts, prior, x, y, w)
+
+        @jax.jit
+        def finalize(counts, prior):
             log_prior = jnp.log((prior + 1.0) / (prior.sum() + n_classes))
             smoothed = counts + self.smoothing
             log_cond = jnp.log(smoothed / smoothed.sum(axis=1, keepdims=True))
             return log_prior, log_cond
 
-        log_prior, log_cond = train(jnp.asarray(X), jnp.asarray(y))
+        log_prior, log_cond = finalize(counts, prior)
         model = NaiveBayesModel(featuresCol=self.featuresCol, labelCol=self.labelCol)
         model._state = {"log_prior": np.asarray(log_prior),
                         "log_cond": np.asarray(log_cond), "n_classes": n_classes}
@@ -263,23 +388,38 @@ class NaiveBayesModel(HasFeaturesCol, HasLabelCol, Model):
 
 # --------------------------------------------------------------------------
 @register_stage
-class LinearRegression(JaxEstimator):
+class LinearRegression(HasBatchSize, JaxEstimator):
     """Ridge regression by closed-form normal equations (exact, one solve)."""
 
     is_classifier = False
     regParam = FloatParam("regParam", "L2 regularization strength", 1e-6)
 
     def fit(self, frame: Frame) -> "LinearRegressionModel":
-        X, y = self._collect_xy(frame)
-        y = y.astype(np.float32)
+        d = self._peek_dim(frame)
+        bs = self.batchSize
+
+        # Streaming normal equations: accumulate the (d+1)x(d+1) Gram matrix
+        # and moment vector per batch — exact solution in O(batch + d^2)
+        # memory, one MXU matmul per chunk.
+        @jax.jit
+        def accum(A, by, X, y, w):
+            Xb = jnp.concatenate([X, jnp.ones((X.shape[0], 1), X.dtype)],
+                                 axis=1)
+            return A + (Xb * w[:, None]).T @ Xb, by + Xb.T @ (y * w)
+
+        A = jnp.zeros((d + 1, d + 1), jnp.float32)
+        by = jnp.zeros((d + 1,), jnp.float32)
+        for hb in frame.batches(bs, cols=[self.featuresCol, self.labelCol]):
+            x, y, w = _pad_xyw(hb, self.featuresCol, self.labelCol, bs,
+                               np.float32)
+            A, by = accum(A, by, x, y, w)
 
         @jax.jit
-        def solve(X, y):
-            Xb = jnp.concatenate([X, jnp.ones((X.shape[0], 1), X.dtype)], axis=1)
-            A = Xb.T @ Xb + self.regParam * jnp.eye(Xb.shape[1], dtype=X.dtype)
-            return jnp.linalg.solve(A, Xb.T @ y)
+        def solve(A, by):
+            return jnp.linalg.solve(
+                A + self.regParam * jnp.eye(A.shape[0], dtype=A.dtype), by)
 
-        wb = np.asarray(solve(jnp.asarray(X), jnp.asarray(y)))
+        wb = np.asarray(solve(A, by))
         model = LinearRegressionModel(featuresCol=self.featuresCol,
                                       labelCol=self.labelCol)
         model._state = {"w": wb[:-1], "b": float(wb[-1])}
@@ -302,7 +442,7 @@ class LinearRegressionModel(HasFeaturesCol, HasLabelCol, Model):
 
 
 @register_stage
-class MLPRegressor(JaxEstimator):
+class MLPRegressor(HasBatchSize, JaxEstimator):
     is_classifier = False
     hints = FeaturizeHints(one_hot=True, num_features=1 << 12)
 
@@ -312,11 +452,8 @@ class MLPRegressor(JaxEstimator):
     seed = IntParam("seed", "PRNG seed", 0)
 
     def fit(self, frame: Frame) -> "MLPRegressorModel":
-        X, y = self._collect_xy(frame)
-        y = y.astype(np.float32)
-        mu, sigma = X.mean(axis=0), X.std(axis=0) + 1e-6
-        ymu, ysigma = float(y.mean()), float(y.std() + 1e-6)
-        sizes = [X.shape[1]] + [int(h) for h in self.layers] + [1]
+        n, d, mu, sigma, _, ymu, ysigma = self._streaming_stats(frame)
+        sizes = [d] + [int(h) for h in self.layers] + [1]
         key = jax.random.PRNGKey(self.seed)
         params = []
         for i in range(len(sizes) - 1):
@@ -326,19 +463,23 @@ class MLPRegressor(JaxEstimator):
                 "w": jax.random.normal(k, (sizes[i], sizes[i + 1]), jnp.float32) * scale,
                 "b": jnp.zeros((sizes[i + 1],), jnp.float32)})
 
+        mu_d, sigma_d = jnp.asarray(mu), jnp.asarray(sigma)
+
         def forward(p, X):
-            h = X
+            h = (X - mu_d) / sigma_d
             for layer in p[:-1]:
                 h = jax.nn.relu(h @ layer["w"] + layer["b"])
             return (h @ p[-1]["w"] + p[-1]["b"])[:, 0]
 
-        def loss(p, X, y):
-            return ((forward(p, X) - y) ** 2).mean()
+        def loss(p, X, y, w):
+            se = (forward(p, X) - (y - ymu) / ysigma) ** 2
+            return (se * w).sum() / jnp.maximum(w.sum(), 1.0)
 
-        Xd = (jnp.asarray(X) - mu) / sigma
-        yd = (jnp.asarray(y) - ymu) / ysigma
-        params = _full_batch_adam(loss, params, (Xd, yd),
-                                  self.learningRate, self.maxIter)
+        params = _stream_adam(loss, params, frame, self.featuresCol,
+                              self.labelCol, lr=self.learningRate,
+                              max_steps=self.maxIter,
+                              batch_size=self.batchSize,
+                              y_dtype=np.float32)
         model = MLPRegressorModel(featuresCol=self.featuresCol,
                                   labelCol=self.labelCol)
         model._state = {
@@ -374,19 +515,34 @@ class MLPRegressorModel(HasFeaturesCol, HasLabelCol, Model):
 from mmlspark_tpu.core.schema import ColumnSchema, DType  # noqa: E402
 
 
+def _pad_rows(x: np.ndarray, bs: int) -> np.ndarray:
+    """Zero-pad a partial batch up to ``bs`` rows: ONE compiled shape for
+    every batch of a stream (tail rows are sliced off after scoring)."""
+    k = x.shape[0]
+    if k == bs:
+        return x
+    return np.concatenate([x, np.zeros((bs - k,) + x.shape[1:], x.dtype)])
+
+
 def _score_classifier(model, frame: Frame, batch_size: int = 65536) -> Frame:
     """Append prediction / raw scores / probabilities columns.
 
     Streams minibatches to device — the reference's buffered minibatch
-    iterator (``CNTKModel.scala:50-104``) without per-element copies.
+    iterator (``CNTKModel.scala:50-104``) without per-element copies. The
+    tail batch is padded to the compiled shape and sliced after, so a stream
+    never retraces (``CNTKModel.scala:71-76`` semantics, XLA motivation).
     """
     f = model._cached_jit(model.scores_fn)
+    n_rows = frame.count()
+    bs = min(batch_size, max(n_rows, 1))
     preds, scores, probs = [], [], []
-    for batch in frame.batches(batch_size, cols=[model.featuresCol]):
-        logits, p = f(jnp.asarray(batch[model.featuresCol]))
-        preds.append(np.asarray(jnp.argmax(logits, axis=-1)))
-        scores.append(np.asarray(logits))
-        probs.append(np.asarray(p))
+    for batch in frame.batches(bs, cols=[model.featuresCol]):
+        x = np.asarray(batch[model.featuresCol], dtype=np.float32)
+        k = x.shape[0]
+        logits, p = f(jnp.asarray(_pad_rows(x, bs)))
+        preds.append(np.asarray(jnp.argmax(logits, axis=-1))[:k])
+        scores.append(np.asarray(logits)[:k])
+        probs.append(np.asarray(p)[:k])
     pred = np.concatenate(preds) if preds else np.zeros(0, np.int64)
     out = frame.with_column_values(
         ColumnSchema("prediction", DType.FLOAT64), pred.astype(np.float64))
@@ -401,9 +557,13 @@ def _score_classifier(model, frame: Frame, batch_size: int = 65536) -> Frame:
 
 def _score_regressor(model, frame: Frame, batch_size: int = 65536) -> Frame:
     f = model._cached_jit(model.predict_fn)
+    n_rows = frame.count()
+    bs = min(batch_size, max(n_rows, 1))
     preds = []
-    for batch in frame.batches(batch_size, cols=[model.featuresCol]):
-        preds.append(np.asarray(f(jnp.asarray(batch[model.featuresCol]))))
+    for batch in frame.batches(bs, cols=[model.featuresCol]):
+        x = np.asarray(batch[model.featuresCol], dtype=np.float32)
+        k = x.shape[0]
+        preds.append(np.asarray(f(jnp.asarray(_pad_rows(x, bs))))[:k])
     pred = np.concatenate(preds) if preds else np.zeros(0, np.float64)
     return frame.with_column_values(
         ColumnSchema("prediction", DType.FLOAT64), pred.astype(np.float64))
