@@ -381,6 +381,28 @@ class Frame:
         if buffered and not drop_remainder:
             yield {c: _cat(buf[c]) for c in cols}
 
+    def shuffled_batches(self, batch_size: int, cols: Optional[Sequence[str]] = None,
+                         rng: Optional[np.random.Generator] = None,
+                         drop_remainder: bool = False
+                         ) -> Iterator[Dict[str, np.ndarray]]:
+        """Minibatches in a fresh global row permutation (one per call).
+
+        SGD learners need this: sequential ``batches`` on label- or
+        time-ordered data trains each step on a biased slice. Partitions are
+        host-resident, so the gather is one concatenation of the requested
+        columns plus per-batch fancy indexing. Pass a persistent ``rng`` for
+        reproducibility; the default draws fresh OS entropy per call.
+        """
+        rng = rng if rng is not None else np.random.default_rng()
+        cols = list(cols) if cols is not None else self.schema.names
+        arrs = {c: self.column(c) for c in cols}
+        n = self.count()
+        perm = rng.permutation(n)
+        end = n - n % batch_size if drop_remainder else n
+        for off in range(0, end, batch_size):
+            idx = perm[off:off + batch_size]
+            yield {c: arrs[c][idx] for c in cols}
+
     def __repr__(self) -> str:
         cols = ", ".join(f"{c.name}:{c.dtype.value}" for c in self.schema)
         return f"Frame[{cols}] rows={self.count()} partitions={self.num_partitions}"

@@ -31,7 +31,7 @@ from mmlspark_tpu.core.params import (
 from mmlspark_tpu.core.pipeline import Estimator, Model, PipelineModel
 from mmlspark_tpu.core.schema import ColumnSchema, DType, Schema, SchemaError
 from mmlspark_tpu.core.serialization import register_stage
-from mmlspark_tpu.ops.hashing import hash_terms
+from mmlspark_tpu.ops.hashing import hash_token_rows, project_slots
 
 # Reference defaults (Featurize.scala:14-19)
 NUM_FEATURES_DEFAULT = 1 << 18
@@ -120,21 +120,20 @@ class AssembleFeatures(HasFeaturesCol, Estimator):
         # (the BitSet-OR reduce, AssembleFeatures.scala:198-224). Scan only the
         # rows that survive the same NaN cleaning transform will apply,
         # otherwise dropped rows leave permanently-zero slots.
-        active_slots: List[int] = []
+        active_slots = np.zeros(0, np.int64)
         if hash_cols:
             if clean_cols:
                 frame = frame.na_drop([c for c in clean_cols if c in schema])
             nf = self.numberOfFeatures
-            seen = set()
+            parts_slots = []
             for p in frame.partitions:
                 for name in hash_cols:
-                    arr = p[name]
                     is_tokens = schema[name].dtype == DType.TOKENS
-                    for v in arr:
-                        tokens = (v if is_tokens else tokenize(v)) or []
-                        if tokens:
-                            seen.update(hash_terms(tokens, nf).tolist())
-            active_slots = sorted(seen)
+                    rows = (p[name] if is_tokens
+                            else [tokenize(v) for v in p[name]])
+                    slots, _ = hash_token_rows(rows, nf)
+                    parts_slots.append(slots)
+            active_slots = np.unique(np.concatenate(parts_slots))
 
         model = AssembleFeaturesModel(featuresCol=self.featuresCol)
         model._state = {
@@ -185,7 +184,6 @@ class AssembleFeaturesModel(HasFeaturesCol, Model):
             frame = frame.na_drop(clean)
         layout, total = self._layout()
         active_slots = np.asarray(s["active_slots"], dtype=np.int64)
-        slot_pos = {int(slot): i for i, slot in enumerate(active_slots)}
         nf = int(s["num_features"])
 
         def assemble(p) -> np.ndarray:
@@ -205,16 +203,16 @@ class AssembleFeaturesModel(HasFeaturesCol, Model):
                 out[:, start:start + dim] = np.asarray(p[name], dtype=np.float32)
             if s["hash_cols"]:
                 start = next(l[1] for l in layout if l[3] == "hashed")
-                for j, (name, is_tok) in enumerate(
-                        zip(s["hash_cols"], s["hash_col_is_tokens"])):
-                    for i, v in enumerate(p[name]):
-                        tokens = (v if is_tok else tokenize(v)) or []
-                        if not tokens:
-                            continue
-                        for slot in hash_terms(tokens, nf):
-                            pos = slot_pos.get(int(slot))
-                            if pos is not None:
-                                out[i, start + pos] += 1.0
+                for name, is_tok in zip(s["hash_cols"],
+                                        s["hash_col_is_tokens"]):
+                    rows = (p[name] if is_tok
+                            else [tokenize(v) for v in p[name]])
+                    slots, row_ptr = hash_token_rows(rows, nf)
+                    rids = np.repeat(np.arange(n, dtype=np.int64),
+                                     np.diff(row_ptr))
+                    pos, ok = project_slots(active_slots, slots)
+                    # accumulate counts (a slot can repeat within a row)
+                    np.add.at(out, (rids[ok], start + pos[ok]), 1.0)
             return out
 
         col = ColumnSchema(

@@ -31,7 +31,7 @@ from mmlspark_tpu.core.params import (
 from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
 from mmlspark_tpu.core.schema import ColumnSchema, DType, SchemaError
 from mmlspark_tpu.core.serialization import register_stage
-from mmlspark_tpu.ops.hashing import hash_terms, term_frequencies
+from mmlspark_tpu.ops.hashing import hash_token_rows, project_slots, tf_csr
 
 # A standard English stop-word list (the classic Glasgow IR list that Spark's
 # StopWordsRemover also ships). Public-domain word list.
@@ -160,27 +160,44 @@ class NGram(HasInputCol, HasOutputCol, Transformer):
 class HashingTF(HasInputCol, HasOutputCol, Estimator):
     """Tokens -> term-frequency vectors in a murmur3 hash space.
 
-    Estimator (unlike Spark's stateless transformer) because the fitted model
-    compacts the 2^18 hash space to the active slots seen at fit — the
-    TPU-first dense layout. Slot indices are bit-identical to Spark's
+    Estimator (unlike Spark's stateless transformer) because by default the
+    fitted model compacts the 2^18 hash space to the active slots seen at fit
+    — the TPU-first dense layout. Slot indices are bit-identical to Spark's
     (``ops/hashing.py``), so a term's position within the active-slot ordering
     is auditable against the reference's pinned indices
     (``core/ml/src/test/scala/HashingTFSpec.scala:22-29``).
+
+    ``compact=False`` restores Spark's stateless fixed-width contract: the
+    output vector is always ``numFeatures`` wide and terms unseen at fit
+    still land in their slot — use it when fitted models must stay
+    column-compatible across datasets (e.g. serving with novel vocabulary).
+    With the default ``compact=True``, unseen-at-fit terms are DROPPED at
+    transform: width tracks the training corpus.
+
+    NOTE: the output column is DENSE, so ``compact=False`` materializes
+    n_rows x numFeatures float32 — pair it with a modest ``numFeatures``
+    (e.g. 2^12), not the 2^18 default; transform raises rather than OOM.
     """
 
     numFeatures = IntParam("numFeatures", "hash space size", 1 << 18,
                            validator=lambda v: v > 0)
     binary = BooleanParam("binary", "clamp term counts to 1", False)
+    compact = BooleanParam(
+        "compact", "compact output to fit-time active slots (False = "
+        "Spark-parity fixed numFeatures width)", True)
 
     def fit(self, frame: Frame) -> "HashingTFModel":
         _require_dtype(frame, self.inputCol, DType.TOKENS, "HashingTF")
-        active: set = set()
-        for row in _token_rows(frame, self.inputCol):
-            active.update(hash_terms(row, self.numFeatures).tolist())
         model = HashingTFModel(
             inputCol=self.inputCol, outputCol=self.outputCol,
-            numFeatures=self.numFeatures, binary=self.binary)
-        model._set_state({"slots": np.asarray(sorted(active), dtype=np.int64)})
+            numFeatures=self.numFeatures, binary=self.binary,
+            compact=self.compact)
+        if self.compact:
+            slots, _ = hash_token_rows(
+                _token_rows(frame, self.inputCol), self.numFeatures)
+            model._set_state({"slots": np.unique(slots)})
+        else:  # stateless Spark behavior needs no fit-time scan
+            model._set_state({"slots": np.zeros(0, np.int64)})
         return model
 
 
@@ -188,34 +205,45 @@ class HashingTF(HasInputCol, HasOutputCol, Estimator):
 class HashingTFModel(HasInputCol, HasOutputCol, Model):
     numFeatures = IntParam("numFeatures", "hash space size", 1 << 18)
     binary = BooleanParam("binary", "clamp term counts to 1", False)
+    compact = BooleanParam(
+        "compact", "compact output to fit-time active slots (False = "
+        "Spark-parity fixed numFeatures width)", True)
 
     @property
     def slots(self) -> np.ndarray:
         return self._get_state()["slots"]
 
+    @property
+    def width(self) -> int:
+        return len(self.slots) if self.compact else self.numFeatures
+
     def transform(self, frame: Frame) -> Frame:
         _require_dtype(frame, self.inputCol, DType.TOKENS, "HashingTFModel")
-        slots = self.slots  # sorted int64
-        width = len(slots)
-        binary = self.binary
         rows = _token_rows(frame, self.inputCol)
+        width = self.width
+        if len(rows) * width > (1 << 31):  # dense output: fail with guidance
+            raise SchemaError(
+                f"HashingTFModel: dense output {len(rows)}x{width} exceeds "
+                "2^31 elements (~8 GB); lower numFeatures or use "
+                "compact=True so width tracks the training corpus")
         out = np.zeros((len(rows), width), dtype=np.float32)
         if width:
-            for r, sc in enumerate(term_frequencies(rows, self.numFeatures)):
-                if not len(sc):
-                    continue
-                uniq, counts = sc[:, 0], sc[:, 1]
-                pos = np.searchsorted(slots, uniq)
-                ok = (pos < width) & (slots[np.minimum(pos, width - 1)] == uniq)
-                vals = (np.ones_like(counts, np.float32) if binary
-                        else counts.astype(np.float32))
-                out[r, pos[ok]] = vals[ok]  # unseen-at-fit slots are dropped
+            row_ptr, slots, counts = tf_csr(rows, self.numFeatures)
+            rids = np.repeat(np.arange(len(rows), dtype=np.int64),
+                             np.diff(row_ptr))
+            vals = (np.ones_like(counts, np.float32) if self.binary
+                    else counts.astype(np.float32))
+            if self.compact:
+                pos, ok = project_slots(self.slots, slots)
+                out[rids[ok], pos[ok]] = vals[ok]  # unseen-at-fit slots dropped
+            else:
+                out[rids, slots] = vals
         return frame.with_column_values(
             ColumnSchema(self.outputCol, DType.VECTOR, dim=width), out)
 
     def transform_schema(self, schema):
         return schema.add(
-            ColumnSchema(self.outputCol, DType.VECTOR, dim=len(self.slots)))
+            ColumnSchema(self.outputCol, DType.VECTOR, dim=self.width))
 
 
 @register_stage

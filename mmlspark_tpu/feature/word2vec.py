@@ -30,34 +30,58 @@ _TABLE_SIZE = 1 << 16
 
 
 def _build_vocab(rows, min_count: int) -> Tuple[List[str], np.ndarray]:
-    counts: Dict[str, int] = {}
+    from collections import Counter
+    counts: Counter = Counter()
     for row in rows:
-        for tok in row:
-            counts[tok] = counts.get(tok, 0) + 1
+        counts.update(row)
     vocab = sorted([w for w, c in counts.items() if c >= min_count],
                    key=lambda w: (-counts[w], w))
     freqs = np.asarray([counts[w] for w in vocab], dtype=np.float64)
     return vocab, freqs
 
 
+def _flat_ids(rows, index: Dict[str, int]) -> Tuple[np.ndarray, np.ndarray]:
+    """(ids, row_ids) over all in-vocab tokens, corpus-flattened."""
+    ids: List[int] = []
+    row_ids: List[int] = []
+    for r, row in enumerate(rows):
+        for t in row:
+            i = index.get(t)
+            if i is not None:
+                ids.append(i)
+                row_ids.append(r)
+    return np.asarray(ids, np.int32), np.asarray(row_ids, np.int64)
+
+
 def _skipgram_pairs(rows, index: Dict[str, int], window: int,
                     rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray]:
-    centers, contexts = [], []
-    for row in rows:
-        ids = [index[t] for t in row if t in index]
-        n = len(ids)
-        if n < 2:
-            continue
-        # word2vec's dynamic window: per-center effective window in [1, window]
-        spans = rng.integers(1, window + 1, size=n)
-        for i, (c, b) in enumerate(zip(ids, spans)):
-            for j in range(max(0, i - b), min(n, i + b + 1)):
-                if j != i:
-                    centers.append(c)
-                    contexts.append(ids[j])
-    if not centers:
+    """Vectorized skip-gram pair generation with word2vec's dynamic window.
+
+    Each center draws an effective window b in [1, window]; context j pairs
+    with center i iff |i-j| <= b_i within the same row. One masked shift of
+    the corpus-flat id array per offset replaces the reference-era per-row
+    nested Python loop — O(window) numpy passes over the corpus.
+    """
+    ids, row_ids = _flat_ids(rows, index)
+    if ids.size < 2:
         return (np.zeros(0, np.int32), np.zeros(0, np.int32))
-    return (np.asarray(centers, np.int32), np.asarray(contexts, np.int32))
+    spans = rng.integers(1, window + 1, size=ids.size)
+    centers, contexts = [], []
+    for d in range(1, window + 1):
+        if d >= ids.size:
+            break
+        same_row = row_ids[:-d] == row_ids[d:]
+        # center on the left of the pair: include iff its span reaches d
+        m = same_row & (spans[:-d] >= d)
+        centers.append(ids[:-d][m])
+        contexts.append(ids[d:][m])
+        # center on the right of the pair
+        m = same_row & (spans[d:] >= d)
+        centers.append(ids[d:][m])
+        contexts.append(ids[:-d][m])
+    c = np.concatenate(centers) if centers else np.zeros(0, np.int32)
+    x = np.concatenate(contexts) if contexts else np.zeros(0, np.int32)
+    return c.astype(np.int32), x.astype(np.int32)
 
 
 @register_stage
@@ -190,10 +214,11 @@ class Word2VecModel(HasInputCol, HasOutputCol, Model):
         dim = vecs.shape[1]
         rows = frame.column(self.inputCol)
         out = np.zeros((len(rows), dim), dtype=np.float32)
-        for r, row in enumerate(rows):
-            ids = [index[t] for t in row if t in index]
-            if ids:
-                out[r] = vecs[ids].mean(axis=0)
+        ids, row_ids = _flat_ids(rows, index)
+        if ids.size:
+            np.add.at(out, row_ids, vecs[ids])
+            counts = np.bincount(row_ids, minlength=len(rows)).astype(np.float32)
+            out /= np.maximum(counts, 1.0)[:, None]
         return frame.with_column_values(
             ColumnSchema(self.outputCol, DType.VECTOR, dim=dim), out)
 

@@ -155,14 +155,17 @@ def _pad_xyw(hb: Dict[str, np.ndarray], fcol: str, lcol: str, bs: int,
 
 def _stream_adam(loss_fn: Callable, params: Any, frame: Frame,
                  fcol: str, lcol: str, *, lr: float, max_steps: int,
-                 batch_size: int, y_dtype=np.int32) -> Any:
+                 batch_size: int, y_dtype=np.int32, seed: int = 0) -> Any:
     """Minibatch Adam streamed from the frame: ONE compiled step shape,
     epochs cycled until ``max_steps`` optimizer steps have run.
 
-    ``loss_fn(params, x, y, w)`` must be a per-row-weighted loss. When the
-    whole frame fits in a single batch the padded device batch is kept
-    resident across steps (no host->HBM churn), which makes the small-data
-    case equivalent to the old full-batch loop.
+    Each epoch streams a FRESH global row permutation, so ordered data
+    (label- or time-sorted) never biases a step and every row participates
+    as long as ``max_steps`` covers an epoch. ``loss_fn(params, x, y, w)``
+    must be a per-row-weighted loss. When the whole frame fits in a single
+    batch the padded device batch is kept resident across steps (no
+    host->HBM churn), which makes the small-data case equivalent to the old
+    full-batch loop.
     """
     opt = optax.adam(lr)
     opt_state = opt.init(params)
@@ -173,6 +176,19 @@ def _stream_adam(loss_fn: Callable, params: Any, frame: Frame,
         updates, s = opt.update(g, s, p)
         return optax.apply_updates(p, updates), s, loss
 
+    host_rng = np.random.default_rng(seed)
+    # Gather the two training columns ONCE (partitions are host-resident;
+    # this is one concatenation) — epochs then only re-draw a permutation
+    # instead of re-materializing the dataset per epoch.
+    arrs = {c: frame.column(c) for c in (fcol, lcol)}
+    n_rows = len(arrs[fcol])
+
+    def shuffled_batches():
+        perm = host_rng.permutation(n_rows)
+        for off in range(0, n_rows, batch_size):
+            idx = perm[off:off + batch_size]
+            yield {c: arrs[c][idx] for c in (fcol, lcol)}
+
     steps = 0
     resident = None  # device batch reused when the frame is one batch wide
     while steps < max_steps:
@@ -181,7 +197,7 @@ def _stream_adam(loss_fn: Callable, params: Any, frame: Frame,
             steps += 1
             continue
         n_batches, first = 0, None
-        for hb in frame.batches(batch_size, cols=[fcol, lcol]):
+        for hb in shuffled_batches():
             dev = tuple(jax.device_put(a)
                         for a in _pad_xyw(hb, fcol, lcol, batch_size, y_dtype))
             n_batches += 1
@@ -201,9 +217,13 @@ def _stream_adam(loss_fn: Callable, params: Any, frame: Frame,
 # --------------------------------------------------------------------------
 @register_stage
 class LogisticRegression(HasBatchSize, JaxEstimator):
-    """Multinomial logistic regression, full-batch Adam, L2 regularization."""
+    """Multinomial logistic regression trained by streamed minibatch Adam.
 
-    maxIter = IntParam("maxIter", "number of optimizer steps", 200)
+    Epochs are shuffled, the step compiles at one shape, and L2
+    regularization applies to the weights. ``maxIter`` counts minibatch
+    optimizer steps, not full-dataset passes."""
+
+    maxIter = IntParam("maxIter", "number of minibatch optimizer steps", 200)
     regParam = FloatParam("regParam", "L2 regularization strength", 1e-4)
     learningRate = FloatParam("learningRate", "Adam learning rate", 0.1)
 
@@ -333,12 +353,12 @@ class NaiveBayes(HasBatchSize, JaxEstimator):
     smoothing = FloatParam("smoothing", "Laplace smoothing", 1.0)
 
     def fit(self, frame: Frame) -> "NaiveBayesModel":
-        # d from the first row; class count from label metadata when present,
-        # else one cheap label-only pass — no full feature scan needed.
+        # d from the first row; class count from the observed label max AND
+        # the label metadata (metadata alone can under-count when it was fit
+        # elsewhere — a label beyond num_levels would silently one-hot to
+        # zero and vanish from the counts). The label-only pass is cheap.
         d = self._peek_dim(frame)
-        cmap = frame.schema[self.labelCol].categorical
-        ymax = (cmap.num_levels - 1) if cmap is not None \
-            else self._label_max(frame)
+        ymax = self._label_max(frame)
         n_classes = self._num_classes(frame, ymax)
         bs = self.batchSize
 

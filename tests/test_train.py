@@ -292,3 +292,22 @@ def test_scoring_pads_tail_no_retrace():
     probs = out.column("probability")
     assert probs.shape == (n, 2)
     np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_stream_adam_shuffles_ordered_data():
+    # label-sorted frame + maxIter smaller than one epoch: without per-epoch
+    # shuffling every step would see only class 0 and the model would never
+    # learn class 1 (the silent-prefix bug).
+    import numpy as np
+    from mmlspark_tpu.core.frame import Frame
+    from mmlspark_tpu.train.learners import LogisticRegression
+    rng = np.random.default_rng(0)
+    n = 4000
+    X = np.concatenate([rng.normal(-2, 1, (n // 2, 4)),
+                        rng.normal(+2, 1, (n // 2, 4))]).astype(np.float32)
+    y = np.concatenate([np.zeros(n // 2), np.ones(n // 2)])
+    frame = Frame.from_dict({"features": X, "label": y})
+    model = LogisticRegression(batchSize=256, maxIter=10).fit(frame)
+    pred = model.transform(frame).column("prediction")
+    assert (pred[:n // 2] == 0).mean() > 0.9
+    assert (pred[n // 2:] == 1).mean() > 0.9
