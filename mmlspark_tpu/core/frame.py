@@ -117,6 +117,9 @@ class Frame:
         self.schema = schema
         self.partitions = partitions if partitions else [
             {c.name: _empty_column(c) for c in schema}]
+        # memo for multi-partition column() concatenations (partitions are
+        # immutable-by-convention, so the gather never goes stale)
+        self._column_cache: Dict[str, np.ndarray] = {}
         for part in self.partitions:
             lens = {len(part[c.name]) for c in schema}
             if len(lens) > 1:
@@ -178,12 +181,17 @@ class Frame:
 
     # -- column access -----------------------------------------------------
     def column(self, name: str) -> np.ndarray:
-        """Concatenate one column across partitions (driver-side collect)."""
+        """Concatenate one column across partitions (driver-side collect).
+        Multi-partition gathers are memoized, so per-epoch consumers
+        (``shuffled_batches``) pay the concatenation once per frame."""
         self.schema[name]
         arrs = [p[name] for p in self.partitions]
         if len(arrs) == 1:
             return arrs[0]
-        return np.concatenate(arrs, axis=0)
+        cached = self._column_cache.get(name)
+        if cached is None:
+            cached = self._column_cache[name] = np.concatenate(arrs, axis=0)
+        return cached
 
     def collect(self) -> Dict[str, np.ndarray]:
         return {n: self.column(n) for n in self.schema.names}
@@ -389,9 +397,10 @@ class Frame:
 
         SGD learners need this: sequential ``batches`` on label- or
         time-ordered data trains each step on a biased slice. Partitions are
-        host-resident, so the gather is one concatenation of the requested
-        columns plus per-batch fancy indexing. Pass a persistent ``rng`` for
-        reproducibility; the default draws fresh OS entropy per call.
+        host-resident and the column gather is memoized on the frame, so
+        per-epoch calls pay only the permutation plus per-batch fancy
+        indexing. Pass a persistent ``rng`` for reproducibility; the default
+        draws fresh OS entropy per call.
         """
         rng = rng if rng is not None else np.random.default_rng()
         cols = list(cols) if cols is not None else self.schema.names

@@ -1,0 +1,62 @@
+"""Typed metric contracts: the reference's MetricData re-expressed.
+
+Reference ``core/contracts/src/main/scala/Metrics.scala:37-47`` defines
+``MetricData.create/createTable`` — typed records that evaluators log.
+Here the same contract: a scalar ``MetricValue`` and a ``MetricTable``
+(named 2-D table, e.g. a confusion matrix or ROC curve), both renderable
+to a Frame (the observable API) and loggable through the framework logger
+(the reference logs accuracy/ROC tables at
+``ComputeModelStatistics.scala:486-521``).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class MetricValue:
+    name: str
+    value: float
+    model_uid: str = ""
+
+    def log(self, logger=None) -> None:
+        from mmlspark_tpu.utils.logging import get_logger
+        (logger or get_logger("metrics")).info(
+            "metric %s=%.6g%s", self.name, self.value,
+            f" model={self.model_uid}" if self.model_uid else "")
+
+
+@dataclass(frozen=True)
+class MetricTable:
+    name: str
+    columns: Sequence[str]
+    rows: Any  # (n, len(columns)) array-like
+    model_uid: str = ""
+
+    def to_frame(self):
+        from mmlspark_tpu.core.frame import Frame
+        arr = np.asarray(self.rows)
+        return Frame.from_dict(
+            {c: arr[:, i] for i, c in enumerate(self.columns)})
+
+    def log(self, logger=None) -> None:
+        from mmlspark_tpu.utils.logging import get_logger
+        log = logger or get_logger("metrics")
+        arr = np.asarray(self.rows)
+        log.info("metric table %s (%d rows x %s)%s", self.name, len(arr),
+                 list(self.columns),
+                 f" model={self.model_uid}" if self.model_uid else "")
+
+
+def create(name: str, value: float, model_uid: str = "") -> MetricValue:
+    """``MetricData.create`` parity (Metrics.scala:37-41)."""
+    return MetricValue(name, float(value), model_uid)
+
+
+def create_table(name: str, columns: Sequence[str], rows: Any,
+                 model_uid: str = "") -> MetricTable:
+    """``MetricData.createTable`` parity (Metrics.scala:42-47)."""
+    return MetricTable(name, list(columns), rows, model_uid)

@@ -212,6 +212,20 @@ class ComputeModelStatistics(Transformer):
         return map_labels_to_indices(arr, cmap)
 
     def _metrics_frame(self, metrics: Dict[str, float], order: List[str]) -> Frame:
+        # Log through the MetricData contract, like the reference's
+        # accuracy/ROC table logging (ComputeModelStatistics.scala:486-521).
+        from mmlspark_tpu.core import metrics as metric_data
+        for name, value in metrics.items():
+            metric_data.create(name, value, model_uid=self.uid).log()
+        if self.confusion_matrix is not None:
+            k = self.confusion_matrix.shape[0]
+            metric_data.create_table(
+                "confusion_matrix", [str(i) for i in range(k)],
+                self.confusion_matrix, model_uid=self.uid).log()
+        if self.roc_curve is not None:
+            metric_data.create_table(
+                "roc_curve", ["fpr", "tpr"],
+                self.roc_curve, model_uid=self.uid).log()
         want = self.evaluationMetric
         if want != ALL_METRICS:
             if want not in metrics:

@@ -83,16 +83,36 @@ def _vmix_h1(h1: np.ndarray, k1: np.ndarray) -> np.ndarray:
 def murmur3_batch(terms: Sequence[str], seed: int = SPARK_SEED) -> np.ndarray:
     """Vectorized murmur3 over a batch of terms (signed int32 per term).
 
-    All terms' bytes land in one padded uint8 matrix; each 4-byte word
-    position is mixed across the whole batch in one vector op (per-row
-    validity masked by length), then the trailing 1-3 bytes mix sign-extended
-    exactly like the scalar path. O(max_term_len) numpy passes total.
+    Terms are grouped into power-of-two length buckets so one long outlier
+    (a URL, an un-split blob) can't inflate the padded byte matrix for the
+    whole batch; within a bucket padding is bounded at 2x. Each bucket's
+    bytes land in one padded uint8 matrix; each 4-byte word position is
+    mixed across the bucket in one vector op (per-row validity masked by
+    length), then the trailing 1-3 bytes mix sign-extended exactly like the
+    scalar path. O(max_term_len_in_bucket) numpy passes per bucket.
     """
     n = len(terms)
     if n == 0:
         return np.zeros(0, np.int32)
     encoded = [t.encode("utf-8") for t in terms]
     lens = np.fromiter((len(b) for b in encoded), np.int64, n)
+    buckets = np.zeros(n, np.int64)
+    nz = lens > 4
+    buckets[nz] = np.ceil(np.log2(lens[nz])).astype(np.int64)
+    uniq = np.unique(buckets)
+    if len(uniq) == 1:
+        return _murmur3_batch_core(encoded, lens, seed)
+    out = np.empty(n, np.int32)
+    for b in uniq:
+        idx = np.nonzero(buckets == b)[0]
+        out[idx] = _murmur3_batch_core([encoded[i] for i in idx],
+                                       lens[idx], seed)
+    return out
+
+
+def _murmur3_batch_core(encoded: Sequence[bytes], lens: np.ndarray,
+                        seed: int) -> np.ndarray:
+    n = len(encoded)
     maxlen = int(lens.max())
     with np.errstate(over="ignore"):
         if maxlen == 0:

@@ -16,8 +16,10 @@ via the same mesh.
 """
 from __future__ import annotations
 
+import queue
+import threading
 from functools import partial
-from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -29,8 +31,90 @@ from mmlspark_tpu.parallel.mesh import data_parallel_mesh
 from mmlspark_tpu.parallel.sharding import (
     batch_sharding, param_shardings, Rules, shard_batch,
 )
+from mmlspark_tpu.utils import config as mmlconfig
+from mmlspark_tpu.utils.logging import MetricLogger
 
 LossFn = Callable[[Any, Dict[str, jax.Array], jax.Array], jax.Array]
+
+
+class DevicePrefetcher:
+    """Double-buffered host->HBM prefetch (SURVEY.md §7 "streaming host→HBM
+    without stalls").
+
+    A background thread pulls host batches and commits their ``device_put``
+    while the current step computes, so the accelerator never waits on the
+    host: the next sharded batch is already in HBM when the step returns.
+    ``depth`` bounds in-flight device batches (device memory = depth x batch).
+    Exceptions in the producer re-raise at the consuming ``next()``.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, host_batches: Iterable[Dict[str, np.ndarray]],
+                 put: Callable[[Dict[str, np.ndarray]], Any],
+                 depth: Optional[int] = None):
+        self.depth = depth if depth is not None else int(
+            mmlconfig.get("runtime.prefetch_depth"))
+        self._q: queue.Queue = queue.Queue(maxsize=max(self.depth, 1))
+        self._err: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._done = False
+
+        def run():
+            try:
+                for hb in host_batches:
+                    if self._stop.is_set():
+                        return
+                    item = put(hb)
+                    # bounded put that notices close(): never blocks forever
+                    while not self._stop.is_set():
+                        try:
+                            self._q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+            except BaseException as e:  # surfaced on the consumer side
+                self._err = e
+            finally:
+                # bounded sentinel put: a full queue must not lose the
+                # end-of-stream marker, but close() must still unblock us
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(self._SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="mmlspark-tpu-prefetch")
+        self._thread.start()
+
+    def close(self) -> None:
+        """Stop the producer and drop queued device batches (frees HBM).
+        Call from a ``finally`` when abandoning the stream early."""
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5)
+        self._done = True
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        if self._done:
+            raise StopIteration
+        item = self._q.get()
+        if item is self._SENTINEL:
+            self._done = True
+            self._thread.join()
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
 
 
 class DistributedTrainer:
@@ -164,14 +248,30 @@ class DistributedTrainer:
     def fit(self, state, batches: Iterable[Dict[str, np.ndarray]],
             rng: Optional[jax.Array] = None,
             log_every: int = 0,
-            log_fn: Callable[[int, float], None] = None) -> Tuple[Any, list]:
-        """Drive an epoch of host batches through the sharded step."""
+            log_fn: Callable[[int, float], None] = None,
+            prefetch: Optional[int] = None) -> Tuple[Any, list]:
+        """Drive an epoch of host batches through the sharded step.
+
+        Host->HBM transfer is double-buffered: a DevicePrefetcher thread
+        commits the next batch's ``device_put`` while the current step
+        computes (depth from ``prefetch`` or the ``runtime.prefetch_depth``
+        config key). ``log_every``>0 emits step/loss/examples-per-sec
+        through the MetricLogger (or a custom ``log_fn(step, loss)``).
+        """
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         losses = []
-        for i, host_batch in enumerate(batches):
-            batch = self.put_batch(host_batch)
-            state, metrics = self.train_step(state, batch, rng)
-            losses.append(metrics["loss"])  # device scalar: no sync per step
-            if log_every and log_fn and i % log_every == 0:
-                log_fn(i, float(losses[-1]))
+        metric_log = MetricLogger(every=log_every) if log_every else None
+        prefetcher = DevicePrefetcher(batches, self.put_batch, depth=prefetch)
+        try:
+            for i, batch in enumerate(prefetcher):
+                rows = next(iter(batch.values())).shape[0] if batch else 0
+                state, metrics = self.train_step(state, batch, rng)
+                losses.append(metrics["loss"])  # device scalar: no per-step sync
+                if log_fn is not None and log_every and i % log_every == 0:
+                    log_fn(i, float(losses[-1]))
+                elif metric_log is not None:  # cadence handled inside (no
+                    metric_log(i, {"loss": losses[-1]},  # sync off-cadence)
+                               batch_rows=rows)
+        finally:
+            prefetcher.close()  # frees queued HBM batches if we exited early
         return state, [float(l) for l in jax.device_get(losses)]

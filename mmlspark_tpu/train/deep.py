@@ -195,22 +195,37 @@ class DeepClassifier(JaxEstimator):
         start_epoch = done // steps_per_epoch
         skip_in_epoch = done - start_epoch * steps_per_epoch
         rng = jax.random.PRNGKey(seed)
-        log_every = self.logEvery
         step, last_loss = done, None
-        for epoch in range(start_epoch, self.epochs):
-            for j, hb in enumerate(frame.batches(bs, cols=[fcol, lcol])):
-                if epoch == start_epoch and j < skip_in_epoch:
-                    continue
-                batch = trainer.put_batch(self._pad_batch(hb, fcol, lcol, bs))
-                state, metrics = trainer.train_step(state, batch, rng)
-                last_loss = metrics["loss"]  # device scalar: no sync per step
-                step += 1
-                if log_every and step % log_every == 0:
-                    print(f"DeepClassifier step {step}/{total_steps} "
-                          f"loss={float(last_loss):.4f}")
-                if ckpt is not None:
-                    ckpt.maybe_save(state, every=self.checkpointEvery,
-                                    step=step)
+
+        def host_batches():
+            """Padded fixed-shape batches, shuffled per epoch. The epoch's
+            permutation is seeded by (seed, epoch) so an elastic resume
+            replays the SAME order and the arithmetic skip stays aligned."""
+            for epoch in range(start_epoch, self.epochs):
+                epoch_rng = np.random.default_rng([seed, epoch])
+                for j, hb in enumerate(frame.shuffled_batches(
+                        bs, cols=[fcol, lcol], rng=epoch_rng)):
+                    if epoch == start_epoch and j < skip_in_epoch:
+                        continue
+                    yield self._pad_batch(hb, fcol, lcol, bs)
+
+        from mmlspark_tpu.parallel.trainer import DevicePrefetcher
+        from mmlspark_tpu.utils.logging import MetricLogger
+        from mmlspark_tpu.utils.profiling import trace
+        metric_log = MetricLogger(every=self.logEvery, name="DeepClassifier")
+        prefetcher = DevicePrefetcher(host_batches(), trainer.put_batch)
+        try:
+            with trace():  # captures a jax trace iff profiling.trace_dir set
+                for batch in prefetcher:
+                    state, metrics = trainer.train_step(state, batch, rng)
+                    last_loss = metrics["loss"]  # device scalar; no step sync
+                    step += 1
+                    metric_log(step, {"loss": last_loss}, batch_rows=bs)
+                    if ckpt is not None:
+                        ckpt.maybe_save(state, every=self.checkpointEvery,
+                                        step=step)
+        finally:
+            prefetcher.close()  # frees queued HBM batches on early exit
         if ckpt is not None:
             ckpt.save(state, step=step, wait=True)
         if last_loss is None:

@@ -177,18 +177,6 @@ def _stream_adam(loss_fn: Callable, params: Any, frame: Frame,
         return optax.apply_updates(p, updates), s, loss
 
     host_rng = np.random.default_rng(seed)
-    # Gather the two training columns ONCE (partitions are host-resident;
-    # this is one concatenation) — epochs then only re-draw a permutation
-    # instead of re-materializing the dataset per epoch.
-    arrs = {c: frame.column(c) for c in (fcol, lcol)}
-    n_rows = len(arrs[fcol])
-
-    def shuffled_batches():
-        perm = host_rng.permutation(n_rows)
-        for off in range(0, n_rows, batch_size):
-            idx = perm[off:off + batch_size]
-            yield {c: arrs[c][idx] for c in (fcol, lcol)}
-
     steps = 0
     resident = None  # device batch reused when the frame is one batch wide
     while steps < max_steps:
@@ -197,7 +185,8 @@ def _stream_adam(loss_fn: Callable, params: Any, frame: Frame,
             steps += 1
             continue
         n_batches, first = 0, None
-        for hb in shuffled_batches():
+        for hb in frame.shuffled_batches(batch_size, cols=[fcol, lcol],
+                                         rng=host_rng):
             dev = tuple(jax.device_put(a)
                         for a in _pad_xyw(hb, fcol, lcol, batch_size, y_dtype))
             n_batches += 1

@@ -1,0 +1,82 @@
+"""Global configuration tier: namespaced knobs with env-var overrides.
+
+Re-expression of the reference's typesafe-config scheme
+(``core/env/src/main/scala/Configuration.scala:28-46``), which exposed a
+``mmlspark.{sdk,cntk,tlc}`` namespace tree. Here the namespaces are
+``mmlspark_tpu.{runtime,logging,profiling}`` and every key resolves, in
+order: programmatic ``set()`` > environment variable
+``MMLSPARK_TPU_<NAMESPACE>_<KEY>`` (upper-cased) > registered default.
+
+This is the third config tier next to (1) per-stage ``Param``s and (2) the
+launcher's CLI flags — the same three-tier split as the reference
+(SURVEY.md §5 "Config / flag system").
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_DEFAULTS: Dict[str, Any] = {
+    # runtime
+    "runtime.prefetch_depth": 2,      # host->device prefetch queue depth
+    "runtime.decode_threads": 0,      # 0 = native codec picks (ncpu)
+    # logging
+    "logging.level": "INFO",
+    "logging.metrics_every": 0,       # default train-metric log cadence (steps)
+    # profiling
+    "profiling.trace_dir": "",        # non-empty = capture jax traces here
+}
+
+_lock = threading.Lock()
+_overrides: Dict[str, Any] = {}
+
+
+def _env_key(key: str) -> str:
+    return "MMLSPARK_TPU_" + key.replace(".", "_").upper()
+
+
+def get(key: str, default: Any = None) -> Any:
+    """Resolve a config key (``namespace.name``)."""
+    with _lock:
+        if key in _overrides:
+            return _overrides[key]
+    env = os.environ.get(_env_key(key))
+    if env is not None:
+        base = _DEFAULTS.get(key, default)
+        return _coerce(env, base)
+    if key in _DEFAULTS:
+        return _DEFAULTS[key]
+    if default is not None:
+        return default
+    raise KeyError(f"unknown config key {key!r}; known: {sorted(_DEFAULTS)}")
+
+
+def set(key: str, value: Any) -> None:  # noqa: A001 - mirrors typesafe API
+    """Programmatic override (highest precedence). Unknown keys are allowed
+    so applications can park their own knobs in the same tree."""
+    with _lock:
+        _overrides[key] = value
+
+
+def unset(key: str) -> None:
+    with _lock:
+        _overrides.pop(key, None)
+
+
+def snapshot() -> Dict[str, Any]:
+    """Fully-resolved view of every known key (for logs / debugging)."""
+    keys = set_keys = dict(_DEFAULTS)
+    with _lock:
+        set_keys.update(_overrides)
+    return {k: get(k, keys.get(k)) for k in sorted(set_keys)}
+
+
+def _coerce(text: str, like: Any) -> Any:
+    if isinstance(like, bool):
+        return text.lower() in ("1", "true", "yes", "on")
+    if isinstance(like, int):
+        return int(text)
+    if isinstance(like, float):
+        return float(text)
+    return text

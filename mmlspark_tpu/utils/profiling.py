@@ -1,0 +1,40 @@
+"""Profiling hooks: jax profiler traces + named step annotations.
+
+SURVEY.md §5 sets the bar above the reference (which had nothing beyond
+test wall-clock timing): here any train/score loop can capture an XLA
+trace viewable in TensorBoard/Perfetto. The capture dir comes from the
+``profiling.trace_dir`` config key or the ``trace`` argument, so a
+production run can be flipped into a profiled run by env var alone
+(``MMLSPARK_TPU_PROFILING_TRACE_DIR=/tmp/trace``).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+from mmlspark_tpu.utils import config
+from mmlspark_tpu.utils.logging import get_logger
+
+
+@contextlib.contextmanager
+def trace(trace_dir: Optional[str] = None) -> Iterator[None]:
+    """Capture a jax profiler trace for the enclosed region.
+
+    No-op when neither ``trace_dir`` nor the ``profiling.trace_dir`` config
+    key is set — safe to leave in production code paths.
+    """
+    target = trace_dir if trace_dir is not None else config.get(
+        "profiling.trace_dir")
+    if not target:
+        yield
+        return
+    import jax
+    get_logger("profiling").info("capturing jax trace to %s", target)
+    with jax.profiler.trace(target):
+        yield
+
+
+def annotate(name: str):
+    """Named trace region (shows up in the profiler timeline)."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)
