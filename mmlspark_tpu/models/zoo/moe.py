@@ -1,0 +1,164 @@
+"""Mixture-of-Experts: top-k routed FFN sharded over the ``expert`` axis.
+
+The one parallelism family SURVEY.md §2.6 lists that the reference era never
+had — built the TPU way (GShard/Switch style):
+
+- the router is a tiny fp32 Dense; each token picks its top-k experts;
+- dispatch/combine are EINSUMS against one-hot capacity tensors — no
+  gather/scatter, so the whole layer stays MXU-shaped and XLA lowers the
+  token movement to an all-to-all over the ``expert`` mesh axis (the
+  sharding rules place the leading E dim of ``experts_up``/``experts_down``
+  on ``expert``, ``parallel/sharding.DEFAULT_RULES``);
+- per-expert capacity C = ceil(capacity_factor * S * k / E); overflow
+  tokens fall through the residual (standard GShard drop policy);
+- the load-balancing auxiliary loss (Shazeer et al.: E * mean_e(frac
+  tokens routed to e) . mean_e(router prob of e)) is sown under
+  ``("losses", "moe_aux")`` for the trainer to add.
+
+``transformer_lm_moe`` swaps the dense MLP of every other decoder block
+for this layer (via TransformerLM's pluggable block/ffn factories) — the flagship composition: ring/Ulysses attention over ``seq``,
+tensor-parallel projections, expert-parallel FFNs, all in one jitted step.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.models.zoo import register_model
+
+
+class MoeMlp(nn.Module):
+    dim: int
+    num_experts: int = 8
+    expert_hidden: Optional[int] = None   # default 4*dim
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        """x (B, L, D) -> (B, L, D); sows the aux loss under losses/moe_aux."""
+        B, L, D = x.shape
+        E, K = self.num_experts, self.top_k
+        H = self.expert_hidden or 4 * D
+        S = B * L
+        C = max(1, math.ceil(self.capacity_factor * S * K / E))
+        xf = x.reshape(S, D)
+
+        # Router in fp32: tiny matmul, numerically owns the gating decision.
+        logits = nn.Dense(E, dtype=jnp.float32, param_dtype=jnp.float32,
+                          name="router")(xf.astype(jnp.float32))   # (S, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_vals, gate_idx = jax.lax.top_k(probs, K)              # (S, K)
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9)
+
+        # Position of each (token, choice) within its expert's capacity:
+        # choices fill expert slots in (choice-priority, token-order) —
+        # first every token's 1st choice, then 2nd choices, like GShard.
+        # Counting is int32: an fp32 cumsum loses exactness past 2^24
+        # token-choices, silently colliding capacity slots at long context.
+        onehot_i = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)    # (S, K, E)
+        flat = onehot_i.transpose(1, 0, 2).reshape(K * S, E)       # (K*S, E)
+        pos_flat = jnp.cumsum(flat, axis=0) - flat                 # slots used
+        position = (pos_flat.reshape(K, S, E).transpose(1, 0, 2)
+                    * onehot_i).sum(-1)                            # (S, K)
+        keep = (position < C) & (onehot_i.sum(-1) > 0)             # (S, K)
+        onehot = onehot_i.astype(jnp.float32)
+
+        # dispatch (S, K, E, C) collapsed over K -> (S, E, C)
+        cap_onehot = jax.nn.one_hot(position, C, dtype=jnp.float32)
+        dispatch = jnp.einsum("ske,skc->sec",
+                              onehot * keep[..., None], cap_onehot)
+        combine = jnp.einsum("ske,skc->sec",
+                             onehot * (gate_vals * keep)[..., None],
+                             cap_onehot)
+
+        w_up = self.param("experts_up", nn.initializers.lecun_normal(),
+                          (E, D, H), jnp.float32).astype(self.dtype)
+        w_down = self.param("experts_down", nn.initializers.lecun_normal(),
+                            (E, H, D), jnp.float32).astype(self.dtype)
+        # all-to-all happens here under GSPMD: xe is expert-sharded, xf is
+        # batch-sharded
+        xe = jnp.einsum("sec,sd->ecd", dispatch.astype(self.dtype), xf)
+        h = nn.gelu(jnp.einsum("ecd,edh->ech", xe, w_up))
+        ye = jnp.einsum("ech,ehd->ecd", h, w_down)
+        y = jnp.einsum("sec,ecd->sd", combine.astype(self.dtype), ye)
+
+        # Load-balancing aux loss (fp32, scheme-standard scale E). Sown only
+        # outside init so the 'losses' collection never leaks into the
+        # trainable param tree (the optimizer must not "train" a buffer).
+        if not self.is_initializing():
+            frac_routed = (onehot[:, 0, :]).mean(axis=0)  # 1st-choice share
+            mean_prob = probs.mean(axis=0)
+            aux = E * jnp.sum(frac_routed * mean_prob)
+            self.sow("losses", "moe_aux", aux)
+        return y.reshape(B, L, D)
+
+
+def _moe_lm(vocab, dim, depth, heads, max_len, num_experts, top_k,
+            capacity_factor, dtype, attention_fn):
+    """TransformerLM whose odd blocks swap the dense MLP for MoeMlp via the
+    pluggable block/ffn factories — zero duplication of the attention half
+    or the embedding/tied-head trunk (``zoo/transformer.py``)."""
+    from mmlspark_tpu.models.zoo.transformer import DecoderBlock, TransformerLM
+
+    def block_factory(i, name):
+        ffn = None
+        if i % 2 == 1:
+            def ffn(fname):
+                return MoeMlp(dim, num_experts=num_experts, top_k=top_k,
+                              capacity_factor=capacity_factor, dtype=dtype,
+                              name=fname)
+        return DecoderBlock(dim, heads, dtype=dtype,
+                            attention_fn=attention_fn, ffn_factory=ffn,
+                            name=name)
+
+    return TransformerLM(vocab=vocab, dim=dim, depth=depth, heads=heads,
+                         max_len=max_len, dtype=dtype,
+                         attention_fn=attention_fn,
+                         block_factory=block_factory)
+
+
+def moe_aux_loss(variables) -> jnp.ndarray:
+    """Sum of every sown moe_aux term in a ``mutable=['losses']`` pass."""
+    losses = variables.get("losses", {})
+    total = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(losses):
+        total = total + jnp.sum(leaf)
+    return total
+
+
+@register_model("transformer_lm_moe")
+def transformer_lm_moe(vocab: int = 32000, dim: int = 512, depth: int = 6,
+                       heads: int = 8, max_len: int = 2048,
+                       num_experts: int = 8, top_k: int = 2,
+                       capacity_factor: float = 1.25,
+                       dtype=jnp.bfloat16, attention_fn=None):
+    return dict(
+        module=_moe_lm(vocab, dim, depth, heads, max_len, num_experts,
+                       top_k, capacity_factor, dtype, attention_fn),
+        input_shape=(max_len,), input_dtype="int32",
+        feature_layer="hidden", feature_dim=dim,
+        layer_names=["hidden", "logits"],
+    )
+
+
+@register_model("transformer_lm_moe_tiny")
+def transformer_lm_moe_tiny(vocab: int = 256, dim: int = 64, depth: int = 2,
+                            heads: int = 4, max_len: int = 128,
+                            num_experts: int = 4, top_k: int = 2,
+                            capacity_factor: float = 2.0,
+                            dtype=jnp.float32, attention_fn=None):
+    """Test-scale MoE LM (fp32; generous capacity so tiny batches route)."""
+    return dict(
+        module=_moe_lm(vocab, dim, depth, heads, max_len, num_experts,
+                       top_k, capacity_factor, dtype, attention_fn),
+        input_shape=(max_len,), input_dtype="int32",
+        feature_layer="hidden", feature_dim=dim,
+        layer_names=["hidden", "logits"],
+    )

@@ -24,11 +24,18 @@ from mmlspark_tpu.parallel.sequence import full_attention
 
 
 class DecoderBlock(nn.Module):
+    """Pre-norm decoder block with pluggable attention AND FFN.
+
+    ``ffn_factory(name) -> nn.Module`` swaps the dense MLP for a routed one
+    (``zoo/moe.MoeMlp``) without duplicating the attention half — there is
+    exactly one attention implementation to fix.
+    """
     dim: int
     heads: int
     mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[Callable] = None
+    ffn_factory: Optional[Callable[[str], nn.Module]] = None
 
     @nn.compact
     def __call__(self, x):
@@ -45,6 +52,8 @@ class DecoderBlock(nn.Module):
         x = x + nn.Dense(self.dim, dtype=self.dtype,
                          name="attn_out")(o.reshape(B, L, self.dim))
         y = nn.LayerNorm(dtype=jnp.float32, name="norm2")(x)
+        if self.ffn_factory is not None:
+            return x + self.ffn_factory("ffn")(y)
         h = nn.Dense(self.dim * self.mlp_ratio, dtype=self.dtype,
                      name="mlp_up")(y)
         h = nn.gelu(h)
@@ -53,6 +62,9 @@ class DecoderBlock(nn.Module):
 
 
 class TransformerLM(nn.Module):
+    """Decoder LM trunk. ``block_factory(layer_idx, name) -> nn.Module``
+    customizes individual layers (e.g. MoE FFNs on odd layers) while the
+    embedding / positional / tied-head plumbing stays in one place."""
     vocab: int = 32000
     dim: int = 512
     depth: int = 6
@@ -60,6 +72,7 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[Callable] = None
+    block_factory: Optional[Callable[[int, str], nn.Module]] = None
 
     @nn.compact
     def __call__(self, tokens):
@@ -72,9 +85,13 @@ class TransformerLM(nn.Module):
                          (1, self.max_len, self.dim), jnp.float32)
         x = x + pos[:, :L].astype(x.dtype)
         for i in range(self.depth):
-            x = DecoderBlock(self.dim, self.heads, dtype=self.dtype,
-                             attention_fn=self.attention_fn,
-                             name=f"block{i}")(x)
+            if self.block_factory is not None:
+                block = self.block_factory(i, f"block{i}")
+            else:
+                block = DecoderBlock(self.dim, self.heads, dtype=self.dtype,
+                                     attention_fn=self.attention_fn,
+                                     name=f"block{i}")
+            x = block(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="final_norm")(x)
         self.sow("intermediates", "hidden", x)
         # tied head, explicitly fp32 (Embed.attend would demote to self.dtype)

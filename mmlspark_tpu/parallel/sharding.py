@@ -25,6 +25,12 @@ Rules = Sequence[Tuple[str, P]]
 # - embeddings: shard vocab over `tensor`
 # - biases/norm scales: replicated
 DEFAULT_RULES: List[Tuple[str, P]] = [
+    # MoE expert banks: leading E dim over `expert` (the all-to-all axis),
+    # hidden dims over fsdp/tensor like their dense counterparts. The router
+    # stays replicated — it is tiny and every token needs it.
+    (r".*experts?_(up|wi|gate).*", P("expert", "fsdp", "tensor")),
+    (r".*experts?_(down|wo|out).*", P("expert", "tensor", "fsdp")),
+    (r".*router.*", P()),
     (r".*(attention|attn).*(query|key|value|qkv).*kernel", P("fsdp", "tensor")),
     (r".*(attention|attn).*out.*kernel", P("tensor", "fsdp")),
     (r".*mlp.*(up|gate|wi|fc1|intermediate).*kernel", P("fsdp", "tensor")),
@@ -34,6 +40,12 @@ DEFAULT_RULES: List[Tuple[str, P]] = [
     (r".*kernel", P(None, "fsdp")),   # generic dense/conv: shard last-in dim
     (r".*", P()),                     # everything else replicated
 ]
+
+# Catch-all patterns in DEFAULT_RULES whose 2-D specs must NOT be stretched
+# onto >2-D conv kernels — those get the spatial-safe default instead. Only
+# consulted when the DEFAULT rules are in effect; caller-supplied rules are
+# authoritative as written.
+_GENERIC_PATTERNS = {r".*kernel", r".*"}
 
 
 def _path_str(path) -> str:
@@ -69,21 +81,28 @@ def _fit_spec(spec: P, ndim: int, mesh: Mesh, shape) -> P:
 def param_shardings(params: Any, mesh: Mesh,
                     rules: Optional[Rules] = None) -> Any:
     """NamedSharding pytree for model params using name-pattern rules."""
+    using_defaults = rules is None
     rules = list(rules) if rules is not None else DEFAULT_RULES
+
+    def conv_safe(ndim):
+        # conv kernels (H, W, in, out) etc.: never shard spatial dims —
+        # that buys halo collectives for nothing. Shard only the output
+        # features (last dim) over fsdp when divisible.
+        return P(*([None] * (ndim - 1) + ["fsdp"]))
 
     def assign(path, leaf):
         name = _path_str(path)
         ndim = getattr(leaf, "ndim", 0)
         shape = getattr(leaf, "shape", ())
-        if ndim > 2:
-            # conv kernels (H, W, in, out) etc.: never shard spatial dims —
-            # that buys halo collectives for nothing. Shard only the output
-            # features (last dim) over fsdp when divisible.
-            spec = P(*([None] * (ndim - 1) + ["fsdp"]))
-            return NamedSharding(mesh, _fit_spec(spec, ndim, mesh, shape))
         for pattern, spec in rules:
             if re.fullmatch(pattern, name):
+                if (ndim > 2 and using_defaults
+                        and pattern in _GENERIC_PATTERNS):
+                    spec = conv_safe(ndim)
                 return NamedSharding(mesh, _fit_spec(spec, ndim, mesh, shape))
+        if ndim > 2:
+            return NamedSharding(
+                mesh, _fit_spec(conv_safe(ndim), ndim, mesh, shape))
         return NamedSharding(mesh, P())
 
     return jax.tree_util.tree_map_with_path(assign, params)

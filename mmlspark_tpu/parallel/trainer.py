@@ -93,12 +93,15 @@ class DevicePrefetcher:
         """Stop the producer and drop queued device batches (frees HBM).
         Call from a ``finally`` when abandoning the stream early."""
         self._stop.set()
+        # join FIRST (the producer's bounded put notices _stop within 0.1s),
+        # then drain — draining before the join can free a slot that the
+        # producer immediately refills, leaving a batch pinned in HBM
+        self._thread.join(timeout=5)
         while True:
             try:
                 self._q.get_nowait()
             except queue.Empty:
                 break
-        self._thread.join(timeout=5)
         self._done = True
 
     def __iter__(self) -> Iterator[Any]:
@@ -260,16 +263,17 @@ class DistributedTrainer:
         """
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         losses = []
-        metric_log = MetricLogger(every=log_every) if log_every else None
+        metric_log = (MetricLogger(every=log_every)
+                      if log_every and log_fn is None else None)
         prefetcher = DevicePrefetcher(batches, self.put_batch, depth=prefetch)
         try:
             for i, batch in enumerate(prefetcher):
-                rows = next(iter(batch.values())).shape[0] if batch else 0
                 state, metrics = self.train_step(state, batch, rng)
                 losses.append(metrics["loss"])  # device scalar: no per-step sync
                 if log_fn is not None and log_every and i % log_every == 0:
                     log_fn(i, float(losses[-1]))
                 elif metric_log is not None:  # cadence handled inside (no
+                    rows = next(iter(batch.values())).shape[0] if batch else 0
                     metric_log(i, {"loss": losses[-1]},  # sync off-cadence)
                                batch_rows=rows)
         finally:

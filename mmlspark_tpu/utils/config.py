@@ -66,10 +66,10 @@ def unset(key: str) -> None:
 
 def snapshot() -> Dict[str, Any]:
     """Fully-resolved view of every known key (for logs / debugging)."""
-    keys = set_keys = dict(_DEFAULTS)
+    merged = dict(_DEFAULTS)
     with _lock:
-        set_keys.update(_overrides)
-    return {k: get(k, keys.get(k)) for k in sorted(set_keys)}
+        merged.update(_overrides)
+    return {k: get(k, merged[k]) for k in sorted(merged)}
 
 
 def _coerce(text: str, like: Any) -> Any:

@@ -1,0 +1,128 @@
+"""Expert parallelism: MoE routing, capacity, sharding, training.
+
+SURVEY.md §2.6 target row — the parallelism family absent from the
+reference. Runs on the 8-virtual-device CPU mesh from conftest.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from mmlspark_tpu.models.zoo import build_model
+from mmlspark_tpu.models.zoo.moe import MoeMlp, moe_aux_loss
+from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+from mmlspark_tpu.parallel.sharding import param_shardings
+from mmlspark_tpu.parallel.trainer import DistributedTrainer
+
+
+def _apply_moe(x, num_experts=4, top_k=2, capacity_factor=2.0, seed=0):
+    m = MoeMlp(dim=x.shape[-1], num_experts=num_experts, top_k=top_k,
+               capacity_factor=capacity_factor, dtype=jnp.float32)
+    params = m.init(jax.random.PRNGKey(seed), x)
+    y, state = m.apply(params, x, mutable=["losses"])
+    return m, params, y, state
+
+
+def test_moe_output_shape_and_aux_loss():
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+    _, _, y, state = _apply_moe(x)
+    assert y.shape == x.shape
+    assert np.isfinite(np.asarray(y)).all()
+    aux = moe_aux_loss(state)
+    # perfectly balanced top-1 routing gives aux = 1.0; any routing >= 1.0
+    assert float(aux) >= 0.99
+
+
+def test_moe_topk_full_capacity_mixes_expert_outputs():
+    # with k = E and ample capacity every token reaches every expert, so the
+    # output must equal the gate-weighted sum of all expert FFNs
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, 6, 8))
+    m = MoeMlp(dim=8, num_experts=2, top_k=2, capacity_factor=4.0,
+               dtype=jnp.float32)
+    params = m.init(jax.random.PRNGKey(3), x)
+    y, _ = m.apply(params, x, mutable=["losses"])
+    p = params["params"]
+    xf = np.asarray(x).reshape(6, 8)
+    logits = xf @ np.asarray(p["router"]["kernel"]) + np.asarray(p["router"]["bias"])
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    up, down = np.asarray(p["experts_up"]), np.asarray(p["experts_down"])
+
+    def gelu(a):
+        return np.asarray(jax.nn.gelu(jnp.asarray(a)))
+
+    want = np.zeros_like(xf)
+    for e in range(2):
+        want += probs[:, e:e + 1] * (gelu(xf @ up[e]) @ down[e])
+    np.testing.assert_allclose(np.asarray(y).reshape(6, 8), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_moe_capacity_overflow_drops_tokens():
+    # capacity factor so small that C=1: most tokens overflow and the layer
+    # must output zeros for them (residual fall-through), not garbage
+    x = jax.random.normal(jax.random.PRNGKey(4), (1, 32, 8))
+    m = MoeMlp(dim=8, num_experts=2, top_k=1, capacity_factor=0.03,
+               dtype=jnp.float32)
+    params = m.init(jax.random.PRNGKey(5), x)
+    y, _ = m.apply(params, x, mutable=["losses"])
+    y = np.asarray(y).reshape(32, 8)
+    zero_rows = (np.abs(y).max(axis=1) == 0).sum()
+    assert zero_rows >= 30  # 32 tokens, 2 experts x capacity 1
+
+
+def test_expert_params_shard_over_expert_axis():
+    mesh = make_mesh(MeshSpec(data=2, expert=4))
+    spec = build_model("transformer_lm_moe_tiny", num_experts=4, max_len=32)
+    module = spec["module"]
+    params = jax.eval_shape(
+        lambda: module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 32), jnp.int32)))
+    shardings = param_shardings(params, mesh)
+    flat = jax.tree_util.tree_flatten_with_path(shardings)[0]
+    expert_specs = [s.spec for path, s in flat
+                    if "experts_up" in str(path).lower()]
+    assert expert_specs, "no expert params found"
+    for s in expert_specs:
+        assert s[0] == "expert", f"experts_up not sharded over expert: {s}"
+    router_specs = [s.spec for path, s in flat if "router" in str(path).lower()]
+    assert all(all(a is None for a in s) for s in router_specs)
+
+
+def test_moe_lm_trains_on_expert_mesh():
+    mesh = make_mesh(MeshSpec(data=2, expert=4))
+    spec = build_model("transformer_lm_moe_tiny", num_experts=4, max_len=16)
+    module = spec["module"]
+
+    def loss_fn(params, batch, rng):
+        logits, state = module.apply(params, batch["tokens"],
+                                     mutable=["losses"])
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], batch["tokens"][:, 1:]).mean()
+        return ce + 0.01 * moe_aux_loss(state)
+
+    trainer = DistributedTrainer(loss_fn, optax.adamw(1e-3), mesh=mesh)
+    state = trainer.init(
+        lambda: module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((2, 16), jnp.int32)))
+    tokens = np.random.default_rng(0).integers(0, 256, (8, 16), np.int32)
+    batch = trainer.put_batch({"tokens": tokens})
+    losses = []
+    for _ in range(3):
+        state, metrics = trainer.train_step(state, batch, jax.random.PRNGKey(1))
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]  # optimizes through routing + all-to-all
+
+
+def test_moe_init_has_no_losses_collection():
+    # the sown aux loss must never leak into the trainable variables: an
+    # optimizer would otherwise "train" the stale buffer and fake progress
+    spec = build_model("transformer_lm_moe_tiny", num_experts=4, max_len=16)
+    variables = spec["module"].init(jax.random.PRNGKey(0),
+                                    jnp.zeros((1, 16), jnp.int32))
+    assert set(variables.keys()) == {"params"}
+    # and a scoring apply (no mutable) works without a losses collection
+    logits = spec["module"].apply(variables, jnp.zeros((1, 16), jnp.int32))
+    assert logits.shape == (1, 16, 256)
