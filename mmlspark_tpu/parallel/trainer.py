@@ -27,7 +27,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from mmlspark_tpu.parallel.mesh import data_parallel_mesh
+from mmlspark_tpu.parallel.mesh import mesh_from_config
 from mmlspark_tpu.parallel.sharding import (
     batch_sharding, param_shardings, Rules, shard_batch,
 )
@@ -41,10 +41,16 @@ class DevicePrefetcher:
     """Double-buffered host->HBM prefetch (SURVEY.md §7 "streaming host→HBM
     without stalls").
 
-    A background thread pulls host batches and commits their ``device_put``
-    while the current step computes, so the accelerator never waits on the
-    host: the next sharded batch is already in HBM when the step returns.
-    ``depth`` bounds in-flight device batches (device memory = depth x batch).
+    A background thread pulls host batches — the expensive host work: epoch
+    shuffling, tail padding, feature assembly — and queues them ``depth``
+    deep. The consuming ``next()`` commits each batch's ``device_put`` on the
+    caller's thread and returns immediately: JAX dispatch is asynchronous, so
+    the transfer overlaps the still-running previous step and the Python loop
+    stays ahead of the device. All JAX runtime calls therefore happen on ONE
+    thread — issuing ``device_put`` from the producer thread concurrently
+    with a jitted execution aborts flakily inside the multi-device CPU
+    runtime (XLA client race), and single-threaded dispatch loses nothing
+    because the runtime pipelines the async transfers anyway.
     Exceptions in the producer re-raise at the consuming ``next()``.
     """
 
@@ -55,6 +61,7 @@ class DevicePrefetcher:
                  depth: Optional[int] = None):
         self.depth = depth if depth is not None else int(
             mmlconfig.get("runtime.prefetch_depth"))
+        self._put = put
         self._q: queue.Queue = queue.Queue(maxsize=max(self.depth, 1))
         self._err: Optional[BaseException] = None
         self._stop = threading.Event()
@@ -65,11 +72,10 @@ class DevicePrefetcher:
                 for hb in host_batches:
                     if self._stop.is_set():
                         return
-                    item = put(hb)
                     # bounded put that notices close(): never blocks forever
                     while not self._stop.is_set():
                         try:
-                            self._q.put(item, timeout=0.1)
+                            self._q.put(hb, timeout=0.1)
                             break
                         except queue.Full:
                             continue
@@ -90,12 +96,12 @@ class DevicePrefetcher:
         self._thread.start()
 
     def close(self) -> None:
-        """Stop the producer and drop queued device batches (frees HBM).
-        Call from a ``finally`` when abandoning the stream early."""
+        """Stop the producer and drop queued host batches. Call from a
+        ``finally`` when abandoning the stream early."""
         self._stop.set()
         # join FIRST (the producer's bounded put notices _stop within 0.1s),
         # then drain — draining before the join can free a slot that the
-        # producer immediately refills, leaving a batch pinned in HBM
+        # producer immediately refills, keeping a batch buffered
         self._thread.join(timeout=5)
         while True:
             try:
@@ -117,7 +123,7 @@ class DevicePrefetcher:
             if self._err is not None:
                 raise self._err
             raise StopIteration
-        return item
+        return self._put(item)
 
 
 class DistributedTrainer:
@@ -133,7 +139,9 @@ class DistributedTrainer:
                  remat: bool = False):
         self.loss_fn = loss_fn
         self.optimizer = optimizer
-        self.mesh = mesh or data_parallel_mesh()
+        # default honors the launcher's --mesh/runtime.mesh (all-devices
+        # data-parallel when unset), like DeepClassifier's mesh resolution
+        self.mesh = mesh or mesh_from_config()
         self.rules = rules
         self.accum_steps = accum_steps
         self.seq_axis = seq_axis
@@ -256,10 +264,12 @@ class DistributedTrainer:
         """Drive an epoch of host batches through the sharded step.
 
         Host->HBM transfer is double-buffered: a DevicePrefetcher thread
-        commits the next batch's ``device_put`` while the current step
-        computes (depth from ``prefetch`` or the ``runtime.prefetch_depth``
-        config key). ``log_every``>0 emits step/loss/examples-per-sec
-        through the MetricLogger (or a custom ``log_fn(step, loss)``).
+        assembles host batches ahead of the loop, and each ``device_put``
+        dispatches asynchronously on this thread so the transfer overlaps
+        the still-running step (depth from ``prefetch`` or the
+        ``runtime.prefetch_depth`` config key). ``log_every``>0 emits
+        step/loss/examples-per-sec through the MetricLogger (or a custom
+        ``log_fn(step, loss)``).
         """
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         losses = []
@@ -277,5 +287,5 @@ class DistributedTrainer:
                     metric_log(i, {"loss": losses[-1]},  # sync off-cadence)
                                batch_rows=rows)
         finally:
-            prefetcher.close()  # frees queued HBM batches if we exited early
+            prefetcher.close()  # stops the producer if we exited early
         return state, [float(l) for l in jax.device_get(losses)]

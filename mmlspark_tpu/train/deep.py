@@ -55,11 +55,16 @@ from mmlspark_tpu.train.learners import (
 
 
 def _resolve_mesh(mesh_spec):
-    """MeshSpec | axis-size dict | Mesh | None -> Mesh."""
+    """MeshSpec | axis-size dict | Mesh | None -> Mesh. None consults the
+    launcher's ``runtime.mesh`` config (falling back to data-parallel), so
+    ``mmlspark-tpu run train.py --mesh data=2,tensor=4`` reshapes training
+    without touching the script."""
     from jax.sharding import Mesh
-    from mmlspark_tpu.parallel.mesh import MeshSpec, data_parallel_mesh, make_mesh
+    from mmlspark_tpu.parallel.mesh import (
+        MeshSpec, make_mesh, mesh_from_config,
+    )
     if mesh_spec is None:
-        return data_parallel_mesh()
+        return mesh_from_config()
     if isinstance(mesh_spec, Mesh):
         return mesh_spec
     if isinstance(mesh_spec, dict):
@@ -225,7 +230,7 @@ class DeepClassifier(JaxEstimator):
                         ckpt.maybe_save(state, every=self.checkpointEvery,
                                         step=step)
         finally:
-            prefetcher.close()  # frees queued HBM batches on early exit
+            prefetcher.close()  # stops the producer on early exit
         if ckpt is not None:
             ckpt.save(state, step=step, wait=True)
         if last_loss is None:
