@@ -1,0 +1,49 @@
+"""101 - Adult Census Income Training.
+
+Mirrors ``notebooks/samples/101 - Adult Census Income Training.ipynb``:
+select columns, TrainClassifier with a LogisticRegression learner (all
+featurization automatic), save/load the fitted model, score, and evaluate
+with ComputeModelStatistics. Run: ``python examples/101_*.py``.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+
+from _datasets import adult_census
+from mmlspark_tpu.core.frame import Frame
+from mmlspark_tpu.core.serialization import load_stage, save_stage
+from mmlspark_tpu.evaluate.compute_model_statistics import (
+    ComputeModelStatistics,
+)
+from mmlspark_tpu.stages.stages import SelectColumns
+from mmlspark_tpu.train.learners import LogisticRegression
+from mmlspark_tpu.train.train_classifier import TrainClassifier
+
+
+def main(model_dir: str | None = None) -> dict:
+    data = adult_census()
+    # notebook: data = data.select(["education", "marital-status",
+    #                               "hours-per-week", "income"])
+    data = SelectColumns(cols=["education", "marital-status",
+                               "hours-per-week", "income"]).transform(data)
+    parts = data.repartition(4).partitions
+    train = Frame(data.schema, parts[:3])
+    test = Frame(data.schema, parts[3:])
+
+    model = TrainClassifier(model=LogisticRegression(regParam=0.01),
+                            labelCol="income").fit(train)
+
+    model_dir = model_dir or os.path.join(tempfile.mkdtemp(), "AdultCensus.mml")
+    save_stage(model, model_dir)
+    model = load_stage(model_dir)
+
+    scored = model.transform(test)
+    metrics = ComputeModelStatistics().transform(scored)
+    row = {name: float(metrics.column(name)[0]) for name in metrics.columns}
+    print(f"101 census: {row}")
+    return row
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""103 - Before and After MMLSpark.
+
+Mirrors ``notebooks/samples/103 - Before and After MMLSpark.ipynb``:
+the SAME classification task solved twice —
+
+- "before": hand-rolled featurization (ValueIndexer per string column,
+  manual numeric assembly, manual label indexing, raw learner, manual
+  metric computation);
+- "after": one TrainClassifier line + ComputeModelStatistics.
+
+Both land on comparable accuracy; the point is the line count.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from _datasets import adult_census
+from mmlspark_tpu.core.frame import Frame
+from mmlspark_tpu.core.schema import ColumnSchema, DType
+from mmlspark_tpu.evaluate.compute_model_statistics import (
+    ComputeModelStatistics,
+)
+from mmlspark_tpu.feature.value_indexer import ValueIndexer
+from mmlspark_tpu.train.learners import LogisticRegression
+from mmlspark_tpu.train.train_classifier import TrainClassifier
+
+
+def _split(data):
+    parts = data.repartition(4).partitions
+    return Frame(data.schema, parts[:3]), Frame(data.schema, parts[3:])
+
+
+def before(train, test) -> float:
+    """The 'before' path: every step manual."""
+    # index each string column by hand
+    for col in ["education", "marital-status", "income"]:
+        indexer = ValueIndexer(inputCol=col, outputCol=col + "_idx").fit(train)
+        train, test = indexer.transform(train), indexer.transform(test)
+
+    def assemble(frame):
+        cols = [np.asarray(frame.column("education_idx"), np.float32),
+                np.asarray(frame.column("marital-status_idx"), np.float32),
+                np.asarray(frame.column("hours-per-week"), np.float32)]
+        return frame.with_column_values(
+            ColumnSchema("features", DType.VECTOR, 3),
+            np.stack(cols, axis=1))
+
+    train, test = assemble(train), assemble(test)
+    lr = LogisticRegression(featuresCol="features", labelCol="income_idx",
+                            regParam=0.01)
+    model = lr.fit(train.select("features", "income_idx"))
+    scored = model.transform(test.select("features", "income_idx"))
+    # manual accuracy
+    pred = np.asarray(scored.column("prediction"))
+    truth = np.asarray(scored.column("income_idx"), np.float64)
+    return float((pred == truth).mean())
+
+
+def after(train, test) -> float:
+    """The 'after' path: the one-liner."""
+    model = TrainClassifier(model=LogisticRegression(regParam=0.01),
+                            labelCol="income").fit(train)
+    metrics = ComputeModelStatistics().transform(model.transform(test))
+    return float(metrics.column("accuracy")[0])
+
+
+def main() -> dict:
+    train, test = _split(adult_census())
+    acc_before = before(train, test)
+    acc_after = after(train, test)
+    out = {"accuracy_before": acc_before, "accuracy_after": acc_after}
+    print(f"103 before/after: {out}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""201 - Amazon Book Reviews - TextFeaturizer.
+
+Mirrors ``notebooks/samples/201 - Amazon Book Reviews - TextFeaturizer
+.ipynb``: TextFeaturizer turns raw review text into feature vectors (with
+stop-word removal and TF-IDF), a classifier predicts whether the rating is
+positive (>3), and FindBestModel picks among hyperparameter variants.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from _datasets import book_reviews
+from mmlspark_tpu.core.frame import Frame
+from mmlspark_tpu.core.schema import ColumnSchema, DType
+from mmlspark_tpu.evaluate.compute_model_statistics import (
+    ComputeModelStatistics,
+)
+from mmlspark_tpu.evaluate.find_best_model import FindBestModel
+from mmlspark_tpu.feature.text import TextFeaturizer
+from mmlspark_tpu.train.learners import LogisticRegression
+from mmlspark_tpu.train.train_classifier import TrainClassifier
+
+
+def main() -> dict:
+    data = book_reviews()
+    positive = (np.asarray(data.column("rating")) > 3).astype(np.float64)
+    data = data.with_column_values(
+        ColumnSchema("positive", DType.FLOAT64), positive)
+
+    featurizer = TextFeaturizer(
+        inputCol="text", outputCol="features", useStopWordsRemover=True,
+        useIDF=True, minDocFreq=2, numFeatures=1 << 12).fit(data)
+    featurized = featurizer.transform(data).drop("text", "rating")
+
+    parts = featurized.repartition(4).partitions
+    train = Frame(featurized.schema, parts[:3])
+    test = Frame(featurized.schema, parts[3:])
+
+    candidates = [
+        TrainClassifier(model=LogisticRegression(regParam=reg),
+                        labelCol="positive").fit(train)
+        for reg in (0.001, 0.01, 0.1)]
+    best = FindBestModel(models=candidates, evaluationMetric="AUC").fit(train)
+    metrics = ComputeModelStatistics().transform(best.transform(test))
+    out = {m: float(metrics.column(m)[0]) for m in metrics.columns}
+    out["n_candidates"] = len(candidates)
+    print(f"201 text featurizer: {out}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

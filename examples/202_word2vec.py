@@ -1,0 +1,50 @@
+"""202 - Amazon Book Reviews - Word2Vec.
+
+Mirrors ``notebooks/samples/202 - Amazon Book Reviews - Word2Vec.ipynb``:
+tokenize reviews, train Word2Vec embeddings, inspect synonyms, average the
+word vectors per review, and train a classifier on the embedded features.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from _datasets import book_reviews
+from mmlspark_tpu.core.frame import Frame
+from mmlspark_tpu.core.schema import ColumnSchema, DType
+from mmlspark_tpu.evaluate.compute_model_statistics import (
+    ComputeModelStatistics,
+)
+from mmlspark_tpu.feature.text import RegexTokenizer
+from mmlspark_tpu.feature.word2vec import Word2Vec
+from mmlspark_tpu.train.learners import LogisticRegression
+from mmlspark_tpu.train.train_classifier import TrainClassifier
+
+
+def main() -> dict:
+    data = book_reviews()
+    positive = (np.asarray(data.column("rating")) > 3).astype(np.float64)
+    data = data.with_column_values(
+        ColumnSchema("positive", DType.FLOAT64), positive)
+
+    tokenized = RegexTokenizer(inputCol="text",
+                               outputCol="words").transform(data)
+    w2v = Word2Vec(inputCol="words", outputCol="features", vectorSize=32,
+                   minCount=3, maxIter=4, seed=0).fit(tokenized)
+    synonyms = [w for w, _ in w2v.find_synonyms("wonderful", 4)]
+
+    embedded = w2v.transform(tokenized).drop("text", "rating", "words")
+    parts = embedded.repartition(4).partitions
+    train = Frame(embedded.schema, parts[:3])
+    test = Frame(embedded.schema, parts[3:])
+
+    model = TrainClassifier(model=LogisticRegression(),
+                            labelCol="positive").fit(train)
+    metrics = ComputeModelStatistics().transform(model.transform(test))
+    out = {m: float(metrics.column(m)[0]) for m in metrics.columns}
+    out["synonyms_of_wonderful"] = synonyms
+    print(f"202 word2vec: {out}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

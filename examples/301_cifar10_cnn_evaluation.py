@@ -1,0 +1,50 @@
+"""301 - CIFAR10 CNN Evaluation.
+
+Mirrors ``notebooks/samples/301 - CIFAR10 CNTK CNN Evaluation.ipynb``: load
+a trained CNN into the scoring model (JaxModel = the CNTKModel equivalent),
+stream an image frame through it in minibatches, and measure accuracy.
+
+The notebook downloads a pretrained ConvNet; with zero egress this example
+first TRAINS resnet20 briefly through DeepClassifier (the CNTKLearner
+equivalent) on a synthetic CIFAR-shaped dataset, then hands the weights to
+JaxModel for evaluation — the full train -> scoring-model round trip.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from _datasets import cifar_like
+from mmlspark_tpu.image.transformer import UnrollImage
+from mmlspark_tpu.train.deep import DeepClassifier
+from mmlspark_tpu.train.train_classifier import TrainClassifier
+
+
+def main() -> dict:
+    frame = cifar_like(n=256, num_classes=4)
+    unrolled = UnrollImage(inputCol="image",
+                           outputCol="features").transform(frame).drop("image")
+
+    learner = DeepClassifier(architecture="resnet20_cifar",
+                             architectureArgs={"num_classes": 4},
+                             batchSize=64, epochs=6, learningRate=3e-3,
+                             standardize=True)
+    model = TrainClassifier(model=learner, labelCol="labels").fit(unrolled)
+
+    # the fitted deep model exposes a JaxModel (CNTKModel-equivalent):
+    # minibatch streaming, padded tails, layer selection by name
+    jax_model = model.get("learnerModel").to_jax_model()
+    jax_model.set_params(inputCol="features", outputCol="scored",
+                         miniBatchSize=64)
+    scored = jax_model.transform(unrolled)
+    logits = np.asarray(scored.column("scored"))
+    pred = logits.argmax(axis=1)
+    truth = np.asarray(unrolled.column("labels")).astype(int)
+    acc = float((pred == truth).mean())
+    out = {"accuracy": acc, "logit_shape": list(logits.shape),
+           "layers": jax_model.layer_names}
+    print(f"301 cifar eval: {out}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

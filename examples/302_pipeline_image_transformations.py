@@ -1,0 +1,43 @@
+"""302 - Pipeline Image Transformations.
+
+Mirrors ``notebooks/samples/302 - Pipeline Image Transformations.ipynb``:
+read an image directory into a frame, chain declarative ImageTransformer
+stages (resize -> crop -> grayscale -> blur -> threshold), and unroll the
+result to a feature vector.
+"""
+from __future__ import annotations
+
+import tempfile
+
+import numpy as np
+
+from _datasets import image_dir
+from mmlspark_tpu.image.transformer import ImageTransformer, UnrollImage
+from mmlspark_tpu.io.readers import read_images
+
+
+def main() -> dict:
+    root = tempfile.mkdtemp()
+    image_dir(root, n=12)
+    frame = read_images(root, recursive=True)
+
+    tr = (ImageTransformer(inputCol="image", outputCol="transformed")
+          .resize(32, 32)
+          .center_crop(24, 24)
+          .color_format("bgr2gray")
+          .blur(3, 3)
+          .threshold(64, 255, "binary"))
+    out = tr.transform(frame)
+    unrolled = UnrollImage(inputCol="transformed",
+                           outputCol="features").transform(out)
+    feats = np.asarray(unrolled.column("features"))
+    # thresholded grayscale: every pixel is 0 or 255
+    values = set(np.unique(feats).tolist())
+    result = {"n_images": int(feats.shape[0]), "dim": int(feats.shape[1]),
+              "pixel_values": sorted(values)}
+    print(f"302 image transforms: {result}")
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""303 - Transfer Learning by DNN Featurization - Airplane or Automobile.
+
+Mirrors ``notebooks/samples/303 - Transfer Learning by DNN Featurization
+- Airplane or Automobile.ipynb``: featurize images with a deep network cut
+at an intermediate layer (ImageFeaturizer = resize -> unroll -> JaxModel
+with cutOutputLayers), then train a cheap classifier on the embeddings.
+"""
+from __future__ import annotations
+
+import tempfile
+
+import numpy as np
+
+from _datasets import image_dir
+from mmlspark_tpu.core.frame import Frame
+from mmlspark_tpu.core.schema import ColumnSchema, DType
+from mmlspark_tpu.evaluate.compute_model_statistics import (
+    ComputeModelStatistics,
+)
+from mmlspark_tpu.image.featurizer import ImageFeaturizer
+from mmlspark_tpu.io.readers import read_images
+from mmlspark_tpu.train.learners import LogisticRegression
+from mmlspark_tpu.train.train_classifier import TrainClassifier
+
+
+def main() -> dict:
+    root = tempfile.mkdtemp()
+    paths, labels = image_dir(root, n=32)
+    frame = read_images(root, recursive=True)
+    by_path = dict(zip(paths, (float(l) for l in labels)))
+    frame = frame.with_column_values(
+        ColumnSchema("label", DType.FLOAT64),
+        np.asarray([by_path[p] for p in frame.column("path")]))
+
+    # cutOutputLayers=1 -> the 'pool' embedding layer, not the logits head
+    featurizer = ImageFeaturizer(inputCol="image", outputCol="features",
+                                 cutOutputLayers=1, miniBatchSize=16)
+    featurizer.set_model("resnet20_cifar", num_classes=2, seed=0)
+    embedded = featurizer.transform(frame).drop("image", "path")
+
+    parts = embedded.repartition(4).partitions
+    train = Frame(embedded.schema, parts[:3])
+    test = Frame(embedded.schema, parts[3:])
+    model = TrainClassifier(model=LogisticRegression(),
+                            labelCol="label").fit(train)
+    metrics = ComputeModelStatistics().transform(model.transform(test))
+    out = {m: float(metrics.column(m)[0]) for m in metrics.columns}
+    out["embedding_dim"] = embedded.schema["features"].dim
+    print(f"303 transfer learning: {out}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Synthetic stand-ins for the reference notebooks' datasets.
+
+The reference notebooks pull Adult Census / Flight Delay / Amazon Book
+Reviews / CIFAR-10 from blob storage (`/root/reference/notebooks/samples`);
+this environment has zero egress, so each example synthesizes a dataset
+with the same schema and a learnable signal. Sizes are CPU-test friendly.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.frame import Frame
+from mmlspark_tpu.core.schema import ImageValue
+
+
+def adult_census(n: int = 2000, seed: int = 0, num_partitions: int = 2) -> Frame:
+    """Columns mirror notebook 101: education, marital-status, hours-per-week,
+    income label ' <=50K'/' >50K'."""
+    rng = np.random.default_rng(seed)
+    education = rng.choice(
+        ["HS-grad", "Some-college", "Bachelors", "Masters", "Doctorate"], n)
+    marital = rng.choice(["Never-married", "Married", "Divorced"], n)
+    hours = rng.integers(10, 80, n).astype(np.float64)
+    edu_rank = np.array([{"HS-grad": 0, "Some-college": 1, "Bachelors": 2,
+                          "Masters": 3, "Doctorate": 4}[e] for e in education])
+    married = (marital == "Married").astype(float)
+    score = 0.8 * edu_rank + 0.05 * hours + 1.5 * married \
+        + rng.normal(0, 0.8, n)
+    income = np.where(score > 3.4, " >50K", " <=50K").tolist()
+    return Frame.from_dict(
+        {"education": education.tolist(), "marital-status": marital.tolist(),
+         "hours-per-week": hours, "income": income},
+        num_partitions=num_partitions)
+
+
+def flight_delays(n: int = 2000, seed: int = 1, num_partitions: int = 2) -> Frame:
+    """Columns mirror notebook 102: carrier, origin, dep_hour, distance,
+    numeric ArrDelay label."""
+    rng = np.random.default_rng(seed)
+    carrier = rng.choice(["AA", "DL", "UA", "WN"], n)
+    origin = rng.choice(["SEA", "SFO", "JFK", "ORD"], n)
+    dep_hour = rng.integers(5, 23, n).astype(np.float64)
+    distance = rng.uniform(100, 2800, n)
+    carrier_bias = np.array([{"AA": 4.0, "DL": -2.0, "UA": 6.0,
+                              "WN": 0.0}[c] for c in carrier])
+    delay = (carrier_bias + 0.9 * dep_hour
+             + distance * 0.004 + rng.normal(0, 1.5, n))
+    return Frame.from_dict(
+        {"Carrier": carrier.tolist(), "Origin": origin.tolist(),
+         "DepHour": dep_hour, "Distance": distance, "ArrDelay": delay},
+        num_partitions=num_partitions)
+
+
+_POS = ["wonderful", "gripping", "masterpiece", "delightful", "loved",
+        "brilliant", "excellent", "beautiful"]
+_NEG = ["boring", "dreadful", "waste", "disappointing", "hated",
+        "terrible", "awful", "dull"]
+_FILL = ("the book a story of characters plot chapter author reader pages "
+         "writing end beginning world life time people novel").split()
+
+
+def book_reviews(n: int = 1200, seed: int = 2,
+                 num_partitions: int = 2) -> Frame:
+    """Columns mirror notebooks 201/202: free text + rating in {1..5}."""
+    rng = np.random.default_rng(seed)
+    texts, ratings = [], []
+    for i in range(n):
+        rating = int(rng.integers(1, 6))
+        sentiment = _POS if rating > 3 else _NEG
+        k = 2 + (abs(rating - 3))
+        words = list(rng.choice(sentiment, k)) + list(rng.choice(_FILL, 10))
+        rng.shuffle(words)
+        texts.append(" ".join(words))
+        ratings.append(float(rating))
+    return Frame.from_dict({"text": texts, "rating": ratings},
+                           num_partitions=num_partitions)
+
+
+def cifar_like(n: int = 256, seed: int = 3, num_classes: int = 10,
+               num_partitions: int = 2) -> Frame:
+    """32x32x3 uint8 images whose mean brightness encodes the class —
+    learnable by a small convnet in a few steps."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, n)
+    imgs = np.empty(n, dtype=object)
+    for i, y in enumerate(labels):
+        base = 20 + 21 * int(y)
+        img = np.clip(rng.normal(base, 18, (32, 32, 3)), 0, 255).astype(np.uint8)
+        imgs[i] = ImageValue(path=f"mem://cifar/{i}", data=img)
+    frame = Frame.from_dict({"labels": labels.astype(np.float64)},
+                            num_partitions=num_partitions)
+    from mmlspark_tpu.core.schema import ColumnSchema, DType
+    return frame.with_column_values(
+        ColumnSchema("image", DType.IMAGE), imgs)
+
+
+def image_dir(root, n: int = 24, seed: int = 4, size: int = 48):
+    """Write n PNGs under root (half bright 'automobile', half dark
+    'airplane' — notebook 303's two-class setup). Returns (paths, labels)."""
+    import os
+    from mmlspark_tpu.io.codecs import encode_png
+    rng = np.random.default_rng(seed)
+    paths, labels = [], []
+    os.makedirs(root, exist_ok=True)
+    for i in range(n):
+        y = i % 2
+        base = 180 if y else 60
+        img = np.clip(rng.normal(base, 25, (size, size, 3)),
+                      0, 255).astype(np.uint8)
+        p = os.path.join(root, f"img_{i:03d}.png")
+        with open(p, "wb") as f:
+            f.write(encode_png(img))
+        paths.append(p)
+        labels.append(y)
+    return paths, labels

@@ -1,0 +1,123 @@
+"""``mmlspark-tpu`` CLI: the spark-submit-style launcher.
+
+The reference ships ``tools/bin/mml-exec`` (runs spark-shell/pyspark/
+spark-submit against the local build); the TPU-native equivalent launches a
+user script into an initialized distributed JAX process group:
+
+    mmlspark-tpu run train.py --mesh data=-1,tensor=2 \
+        --coordinator 10.0.0.1:8476 --num-processes 16 --process-id 3 -- \
+        --script-arg value
+
+On a single host ``mmlspark-tpu run train.py`` just runs the script (JAX
+auto-detects any cluster env). The ``--mesh`` axes land in the config tier
+(``runtime.mesh``) where ``parallel.mesh.mesh_from_config`` and
+DeepClassifier's default mesh resolution pick them up, so the same script
+scales from laptop CPU to a multi-host slice without edits.
+
+Other subcommands: ``info`` (device + config inventory), ``bench`` (runs
+the repo benchmark when present).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import runpy
+import sys
+from typing import List, Optional
+
+
+def _parse_mesh(text: str) -> dict:
+    """'data=-1,tensor=2' -> {'data': -1, 'tensor': 2} (validated)."""
+    from mmlspark_tpu.parallel.mesh import parse_mesh_axes
+    try:
+        return parse_mesh_axes(text)
+    except ValueError as e:
+        raise SystemExit(f"--mesh: {e}")
+
+
+def cmd_run(args, passthrough: List[str]) -> int:
+    if args.mesh:
+        axes = _parse_mesh(args.mesh)
+        # config tier: visible to mesh_from_config() in the user script AND
+        # to DeepClassifier's default mesh resolution
+        os.environ["MMLSPARK_TPU_RUNTIME_MESH"] = args.mesh
+        from mmlspark_tpu.utils import config
+        config.set("runtime.mesh", args.mesh)
+        del axes
+    script = args.script
+    if not os.path.exists(script):
+        raise SystemExit(f"script not found: {script}")
+    from mmlspark_tpu.parallel.mesh import initialize_multihost
+    initialize_multihost(coordinator_address=args.coordinator,
+                         num_processes=args.num_processes,
+                         process_id=args.process_id)
+    # main() is also an importable in-process API (tests, notebooks) —
+    # restore the interpreter state the script run mutates
+    saved_argv, saved_path = sys.argv, list(sys.path)
+    sys.argv = [script] + passthrough
+    sys.path.insert(0, os.path.dirname(os.path.abspath(script)))
+    try:
+        runpy.run_path(script, run_name="__main__")
+    finally:
+        sys.argv, sys.path[:] = saved_argv, saved_path
+    return 0
+
+
+def cmd_info(args, passthrough) -> int:
+    from mmlspark_tpu.parallel.mesh import device_count_summary
+    from mmlspark_tpu.utils import config
+    info = {"devices": device_count_summary(), "config": config.snapshot()}
+    try:
+        import jax
+        info["backend"] = jax.default_backend()
+    except Exception as e:  # pragma: no cover - backendless env
+        info["backend_error"] = str(e)
+    print(json.dumps(info, indent=2, default=str))
+    return 0
+
+
+def cmd_bench(args, passthrough) -> int:
+    path = os.path.join(os.getcwd(), "bench.py")
+    if not os.path.exists(path):
+        raise SystemExit("no bench.py in the current directory")
+    sys.argv = [path] + passthrough
+    runpy.run_path(path, run_name="__main__")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # split off script passthrough args after `--`
+    passthrough: List[str] = []
+    if "--" in argv:
+        cut = argv.index("--")
+        argv, passthrough = argv[:cut], argv[cut + 1:]
+
+    parser = argparse.ArgumentParser(
+        prog="mmlspark-tpu",
+        description="TPU-native ML pipeline framework launcher")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    run_p = sub.add_parser("run", help="run a script in the process group")
+    run_p.add_argument("script")
+    run_p.add_argument("--mesh", default="",
+                       help="axis sizes, e.g. data=-1,tensor=2 (-1 absorbs)")
+    run_p.add_argument("--coordinator", default=None,
+                       help="host:port of process 0 (multi-host)")
+    run_p.add_argument("--num-processes", type=int, default=None)
+    run_p.add_argument("--process-id", type=int, default=None)
+    run_p.set_defaults(fn=cmd_run)
+
+    info_p = sub.add_parser("info", help="device + config inventory")
+    info_p.set_defaults(fn=cmd_info)
+
+    bench_p = sub.add_parser("bench", help="run ./bench.py")
+    bench_p.set_defaults(fn=cmd_bench)
+
+    args = parser.parse_args(argv)
+    return args.fn(args, passthrough)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

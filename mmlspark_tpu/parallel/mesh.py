@@ -71,6 +71,36 @@ def data_parallel_mesh(devices: Optional[Sequence] = None) -> Mesh:
     return make_mesh(MeshSpec(data=-1), devices)
 
 
+def parse_mesh_axes(text: str) -> Dict[str, int]:
+    """'data=-1,tensor=2' -> {'data': -1, 'tensor': 2}, validated against
+    AXES. The one parser behind both the launcher's --mesh flag and the
+    ``runtime.mesh`` config key."""
+    axes: Dict[str, int] = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        axis, eq, size = part.partition("=")
+        if not eq or not size:
+            raise ValueError(f"bad mesh entry {part!r}: want axis=size")
+        if axis not in AXES:
+            raise ValueError(f"unknown mesh axis {axis!r}; have {AXES}")
+        axes[axis] = int(size)
+    return axes
+
+
+def mesh_from_config(devices: Optional[Sequence] = None) -> Mesh:
+    """Mesh from the ``runtime.mesh`` config key (set by the launcher's
+    ``--mesh data=-1,tensor=2`` flag or MMLSPARK_TPU_RUNTIME_MESH).
+    Falls back to all-devices data parallel when unset — so library code
+    can default to this and the same script scales by flag alone."""
+    from mmlspark_tpu.utils import config
+    text = config.get("runtime.mesh")
+    if not text:
+        return data_parallel_mesh(devices)
+    return make_mesh(MeshSpec(**parse_mesh_axes(text)), devices)
+
+
 def initialize_multihost(coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
                          process_id: Optional[int] = None) -> None:
@@ -88,10 +118,24 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     if coordinator_address is not None:
         kwargs = dict(coordinator_address=coordinator_address,
                       num_processes=num_processes, process_id=process_id)
+    else:
+        # Convenience call with nothing to join: if a backend is already
+        # live in this process (interactive session, test runner), starting
+        # a coordination service now can abort later XLA work — skip.
+        # Reading the backend cache does NOT initialize it.
+        from jax._src import xla_bridge
+        if getattr(xla_bridge, "_backends", None):
+            return
     try:
         jax.distributed.initialize(**kwargs)
     except RuntimeError as e:
-        if "already" in str(e).lower():
+        msg = str(e).lower()
+        if "already" in msg:
+            return
+        if coordinator_address is None and "backend" in msg:
+            # single-process convenience call after the backend is live
+            # (e.g. `mmlspark-tpu run` inside an interactive session):
+            # nothing to join, nothing to do
             return
         raise  # a real multi-host init failure must not be silent
     except ValueError:

@@ -17,7 +17,30 @@ from typing import List, Optional
 import numpy as np
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "libmmlimage.so")
+
+
+def _lib_path() -> str:
+    """Build target: next to the sources when writable (cached per checkout),
+    else a per-user 0700 temp dir (read-only site-packages installs). The
+    temp dir must be OWNED by us and not group/world-writable before we will
+    dlopen anything out of it — a predictable /tmp name that an attacker
+    pre-created with a planted .so must not be trusted."""
+    if os.access(_NATIVE_DIR, os.W_OK):
+        return os.path.join(_NATIVE_DIR, "libmmlimage.so")
+    import tempfile
+    d = os.path.join(tempfile.gettempdir(),
+                     f"mmlspark_tpu_native_{os.getuid()}")
+    try:
+        os.makedirs(d, mode=0o700)
+    except FileExistsError:
+        st = os.lstat(d)
+        if (st.st_uid != os.getuid() or not os.path.isdir(d)
+                or os.path.islink(d) or (st.st_mode & 0o022)):
+            d = tempfile.mkdtemp(prefix="mmlspark_tpu_native_")
+    return os.path.join(d, "libmmlimage.so")
+
+
+_LIB_PATH = _lib_path()
 _BUILD_CMD = [
     "g++", "-O2", "-fPIC", "-shared",
     os.path.join(_NATIVE_DIR, "imagecodec.cc"),

@@ -1,0 +1,87 @@
+"""Launcher + packaging surface (``mmlspark_tpu/cli.py``, pyproject.toml).
+
+The counterpart of the reference's ``tools/bin/mml-exec`` and pip package
+(``tools/pip/setup.py``).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mmlspark_tpu.cli import _parse_mesh, main
+
+
+def test_parse_mesh():
+    assert _parse_mesh("data=-1,tensor=2") == {"data": -1, "tensor": 2}
+    assert _parse_mesh("") == {}
+    with pytest.raises(SystemExit):
+        _parse_mesh("bogus=2")
+    with pytest.raises(SystemExit):
+        _parse_mesh("data")
+
+
+def test_cli_info(capsys):
+    assert main(["info"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["devices"]["global_devices"] >= 1
+    assert "runtime.prefetch_depth" in out["config"]
+
+
+def test_cli_run_executes_script_with_args(tmp_path):
+    script = tmp_path / "prog.py"
+    marker = tmp_path / "ran.txt"
+    script.write_text(
+        "import sys\n"
+        f"open({str(marker)!r}, 'w').write(' '.join(sys.argv[1:]))\n")
+    assert main(["run", str(script), "--", "--alpha", "1"]) == 0
+    assert marker.read_text() == "--alpha 1"
+
+
+def test_cli_run_missing_script():
+    with pytest.raises(SystemExit):
+        main(["run", "/no/such/script.py"])
+
+
+def test_cli_mesh_flag_reaches_config(tmp_path):
+    from mmlspark_tpu.utils import config
+    script = tmp_path / "prog.py"
+    marker = tmp_path / "mesh.txt"
+    script.write_text(
+        "from mmlspark_tpu.utils import config\n"
+        f"open({str(marker)!r}, 'w').write(config.get('runtime.mesh'))\n")
+    try:
+        assert main(["run", str(script), "--mesh", "data=-1,tensor=2"]) == 0
+    finally:
+        config.unset("runtime.mesh")
+        os.environ.pop("MMLSPARK_TPU_RUNTIME_MESH", None)
+    assert marker.read_text() == "data=-1,tensor=2"
+
+
+def test_mesh_from_config_builds_requested_axes():
+    from mmlspark_tpu.parallel.mesh import mesh_from_config
+    from mmlspark_tpu.utils import config
+    config.set("runtime.mesh", "data=-1,tensor=2")
+    try:
+        mesh = mesh_from_config()
+        assert mesh.shape["tensor"] == 2
+        assert mesh.shape["data"] == 4  # 8 virtual devices / tensor 2
+    finally:
+        config.unset("runtime.mesh")
+    # unset -> all-device data parallel
+    assert mesh_from_config().shape["data"] == 8
+
+
+@pytest.mark.slow
+def test_console_script_installed():
+    """`pip install -e .` exposes the mmlspark-tpu entry point."""
+    import shutil
+    exe = shutil.which("mmlspark-tpu")
+    if exe is None:
+        pytest.skip("package not pip-installed in this environment")
+    out = subprocess.run([exe, "info"], capture_output=True, text=True,
+                         env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                         timeout=180)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "global_devices" in out.stdout

@@ -1,0 +1,88 @@
+"""Executes every example headless — the counterpart of the reference's
+notebook CI (``tools/notebook/tester/TestNotebooksLocally.py``), which runs
+each sample notebook with a local session. Here each example's main() runs
+CPU-sized and its returned metrics are sanity-asserted.
+"""
+import importlib.util
+import os
+import sys
+
+import pytest
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "examples")
+
+
+def _run(name: str):
+    path = os.path.join(EXAMPLES_DIR, name)
+    if EXAMPLES_DIR not in sys.path:
+        sys.path.insert(0, EXAMPLES_DIR)
+    spec = importlib.util.spec_from_file_location(
+        name.removesuffix(".py"), path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main()
+
+
+def test_all_examples_present():
+    found = sorted(f for f in os.listdir(EXAMPLES_DIR)
+                   if f[0].isdigit() and f.endswith(".py"))
+    assert [f.split("_")[0] for f in found] == [
+        "101", "102", "103", "201", "202", "301", "302", "303"]
+
+
+def test_101_census():
+    out = _run("101_adult_census_income_training.py")
+    assert out["accuracy"] > 0.75
+    assert 0.0 <= out["AUC"] <= 1.0
+
+
+def test_102_flight_delay():
+    out = _run("102_flight_delay_regression.py")
+    for name in ("LinearRegression", "MLPRegressor"):
+        assert out[name]["r2"] > 0.5, out
+        assert out[name]["mean_L1_loss"] < 20
+    # linear signal: the closed-form solve should be near-perfect
+    assert out["LinearRegression"]["r2"] > 0.9
+
+
+def test_103_before_and_after():
+    out = _run("103_before_and_after.py")
+    assert out["accuracy_before"] > 0.7
+    assert out["accuracy_after"] > 0.7
+
+
+def test_201_text_featurizer():
+    out = _run("201_text_featurizer.py")
+    assert out["accuracy"] > 0.85
+    assert out["AUC"] > 0.9
+
+
+def test_202_word2vec():
+    out = _run("202_word2vec.py")
+    assert out["accuracy"] > 0.8
+    # embedding space must cluster sentiment words together
+    assert any(w in ("gripping", "masterpiece", "delightful", "loved",
+                     "brilliant", "excellent", "beautiful")
+               for w in out["synonyms_of_wonderful"])
+
+
+@pytest.mark.slow
+def test_301_cifar_eval():
+    out = _run("301_cifar10_cnn_evaluation.py")
+    assert out["accuracy"] > 0.5  # 4 classes, brightness signal
+    assert out["logit_shape"][1] == 4
+    assert out["layers"] == ["pool", "head"]
+
+
+def test_302_image_transforms():
+    out = _run("302_pipeline_image_transformations.py")
+    assert out["n_images"] == 12
+    assert out["dim"] == 24 * 24
+    assert set(out["pixel_values"]) <= {0.0, 255.0}
+
+
+@pytest.mark.slow
+def test_303_transfer_learning():
+    out = _run("303_transfer_learning.py")
+    assert out["accuracy"] > 0.85  # bright-vs-dark is easy from embeddings
+    assert out["embedding_dim"] == 64

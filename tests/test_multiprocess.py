@@ -1,0 +1,67 @@
+"""Real 2-process jax.distributed test — the coverage the reference's
+MultiNodeParallelLauncher stub never had (``CommandBuilders.scala:95-117``).
+
+Two OS processes join a coordination service on localhost, form one global
+device view (2 CPU devices each -> 4 global), and run a cross-process sum
+whose collectives ride Gloo — the single-box stand-in for multi-host DCN.
+"""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent("""
+    import sys
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from mmlspark_tpu.parallel.mesh import (
+        initialize_multihost, device_count_summary,
+    )
+    initialize_multihost(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    info = device_count_summary()
+    assert info["process_count"] == 2, info
+    assert info["global_devices"] == 4, info
+    mesh = Mesh(np.asarray(jax.devices()).reshape(4), ("data",))
+    x = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")),
+        np.full((2,), pid + 1.0, np.float32), (4,))
+    total = jax.jit(lambda a: a.sum(),
+                    out_shardings=NamedSharding(mesh, P()))(x)
+    val = float(jax.device_get(total.addressable_data(0)))
+    assert val == 6.0, val   # (1+1) from proc 0 + (2+2) from proc 1
+    print(f"proc {pid} ok {val}")
+""")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_distributed_psum(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    port = str(_free_port())
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(i), port],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for i in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert f"proc {i} ok 6.0" in out
