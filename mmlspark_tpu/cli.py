@@ -37,23 +37,26 @@ def _parse_mesh(text: str) -> dict:
 
 
 def cmd_run(args, passthrough: List[str]) -> int:
+    from mmlspark_tpu.utils import config
     if args.mesh:
-        axes = _parse_mesh(args.mesh)
+        _parse_mesh(args.mesh)  # fail fast on a bad flag
         # config tier: visible to mesh_from_config() in the user script AND
-        # to DeepClassifier's default mesh resolution
+        # to DeepClassifier/DistributedTrainer default mesh resolution
         os.environ["MMLSPARK_TPU_RUNTIME_MESH"] = args.mesh
-        from mmlspark_tpu.utils import config
         config.set("runtime.mesh", args.mesh)
-        del axes
     script = args.script
     if not os.path.exists(script):
         raise SystemExit(f"script not found: {script}")
     from mmlspark_tpu.parallel.mesh import initialize_multihost
-    initialize_multihost(coordinator_address=args.coordinator,
-                         num_processes=args.num_processes,
-                         process_id=args.process_id)
+    try:
+        initialize_multihost(coordinator_address=args.coordinator,
+                             num_processes=args.num_processes,
+                             process_id=args.process_id)
+    except ValueError as e:
+        raise SystemExit(str(e))
     # main() is also an importable in-process API (tests, notebooks) —
-    # restore the interpreter state the script run mutates
+    # restore the interpreter state the script run mutates, including the
+    # mesh override (it is scoped to this launch, not the process)
     saved_argv, saved_path = sys.argv, list(sys.path)
     sys.argv = [script] + passthrough
     sys.path.insert(0, os.path.dirname(os.path.abspath(script)))
@@ -61,6 +64,9 @@ def cmd_run(args, passthrough: List[str]) -> int:
         runpy.run_path(script, run_name="__main__")
     finally:
         sys.argv, sys.path[:] = saved_argv, saved_path
+        if args.mesh:
+            config.unset("runtime.mesh")
+            os.environ.pop("MMLSPARK_TPU_RUNTIME_MESH", None)
     return 0
 
 
@@ -81,8 +87,12 @@ def cmd_bench(args, passthrough) -> int:
     path = os.path.join(os.getcwd(), "bench.py")
     if not os.path.exists(path):
         raise SystemExit("no bench.py in the current directory")
+    saved_argv = sys.argv
     sys.argv = [path] + passthrough
-    runpy.run_path(path, run_name="__main__")
+    try:
+        runpy.run_path(path, run_name="__main__")
+    finally:
+        sys.argv = saved_argv
     return 0
 
 

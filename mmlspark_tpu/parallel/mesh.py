@@ -85,7 +85,12 @@ def parse_mesh_axes(text: str) -> Dict[str, int]:
             raise ValueError(f"bad mesh entry {part!r}: want axis=size")
         if axis not in AXES:
             raise ValueError(f"unknown mesh axis {axis!r}; have {AXES}")
-        axes[axis] = int(size)
+        n = int(size)
+        if n == 0 or n < -1:
+            raise ValueError(
+                f"bad size {n} for mesh axis {axis!r}: want a positive "
+                "size or -1 (absorb remaining devices)")
+        axes[axis] = n
     return axes
 
 
@@ -118,6 +123,13 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     if coordinator_address is not None:
         kwargs = dict(coordinator_address=coordinator_address,
                       num_processes=num_processes, process_id=process_id)
+    elif num_processes is not None or process_id is not None:
+        # Worker flags without a coordinator would silently train alone
+        # while the rest of the cluster hangs at the barrier — refuse.
+        raise ValueError(
+            "num_processes/process_id were given without a "
+            "coordinator_address; pass all three (or none, for "
+            "single-process / auto-detected cluster runs)")
     else:
         # Convenience call with nothing to join: if a backend is already
         # live in this process (interactive session, test runner), starting

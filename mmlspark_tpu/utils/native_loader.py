@@ -24,7 +24,8 @@ def _lib_path() -> str:
     else a per-user 0700 temp dir (read-only site-packages installs). The
     temp dir must be OWNED by us and not group/world-writable before we will
     dlopen anything out of it — a predictable /tmp name that an attacker
-    pre-created with a planted .so must not be trusted."""
+    pre-created with a planted .so must not be trusted. Called lazily from
+    load_native() so merely importing this module touches no filesystem."""
     if os.access(_NATIVE_DIR, os.W_OK):
         return os.path.join(_NATIVE_DIR, "libmmlimage.so")
     import tempfile
@@ -40,20 +41,16 @@ def _lib_path() -> str:
     return os.path.join(d, "libmmlimage.so")
 
 
-_LIB_PATH = _lib_path()
-_BUILD_CMD = [
-    "g++", "-O2", "-fPIC", "-shared",
-    os.path.join(_NATIVE_DIR, "imagecodec.cc"),
-    "-o", _LIB_PATH, "-ljpeg", "-lpng", "-lpthread",
-]
-
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_error: Optional[str] = None
 
 
-def _build() -> None:
-    proc = subprocess.run(_BUILD_CMD, capture_output=True, text=True)
+def _build(lib_path: str) -> None:
+    cmd = ["g++", "-O2", "-fPIC", "-shared",
+           os.path.join(_NATIVE_DIR, "imagecodec.cc"),
+           "-o", lib_path, "-ljpeg", "-lpng", "-lpthread"]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(f"native build failed:\n{proc.stderr[-2000:]}")
 
@@ -65,11 +62,12 @@ def load_native():
         if _lib is not None or _load_error is not None:
             return _lib
         try:
+            lib_path = _lib_path()
             src = os.path.join(_NATIVE_DIR, "imagecodec.cc")
-            if (not os.path.exists(_LIB_PATH)
-                    or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)):
-                _build()
-            lib = ctypes.CDLL(_LIB_PATH)
+            if (not os.path.exists(lib_path)
+                    or os.path.getmtime(lib_path) < os.path.getmtime(src)):
+                _build(lib_path)
+            lib = ctypes.CDLL(lib_path)
             lib.mml_decode_jpeg.restype = ctypes.c_int
             lib.mml_decode_jpeg.argtypes = [
                 ctypes.c_char_p, ctypes.c_long,

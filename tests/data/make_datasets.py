@@ -1,0 +1,101 @@
+"""Generate the canned benchmark CSVs (checked in; run once, deterministic).
+
+The reference pins learner quality on ~20 canned datasets
+(``train-classifier/src/test/scala/VerifyTrainClassifier.scala:177-199`` +
+``benchmarkMetrics.csv``). Those CSVs live outside its repo ($DATASETS_HOME),
+so we synthesize small stand-ins with the same shapes of difficulty:
+
+- banknote_like.csv  — binary, all-numeric (data_banknote_authentication.csv)
+- abalone_like.csv   — multiclass, numeric + one categorical (abalone.csv)
+- pima_like.csv      — binary, numeric with missing cells (PimaIndian.csv)
+- car_eval_like.csv  — multiclass, all-categorical strings (CarEvaluation.csv)
+
+Regenerating rewrites identical bytes (fixed seeds); the golden metrics in
+benchmark_metrics.json are tied to these exact files.
+"""
+import csv
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _write(name, header, rows):
+    with open(os.path.join(HERE, name), "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(header)
+        w.writerows(rows)
+    print(f"wrote {name}: {len(rows)} rows")
+
+
+def banknote_like(n=240):
+    rng = np.random.default_rng(41)
+    X = rng.normal(0, 1.5, (n, 4))
+    score = 1.2 * X[:, 0] - 0.8 * X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+    y = (score + rng.normal(0, 0.6, n) > 0).astype(int)
+    rows = [[f"{v:.4f}" for v in X[i]] + [y[i]] for i in range(n)]
+    _write("banknote_like.csv",
+           ["variance", "skewness", "curtosis", "entropy", "class"], rows)
+
+
+def abalone_like(n=300):
+    rng = np.random.default_rng(42)
+    sex = rng.choice(["M", "F", "I"], n)
+    length = rng.uniform(0.1, 0.8, n)
+    diameter = length * rng.uniform(0.7, 0.9, n)
+    weight = length ** 3 * rng.uniform(3.5, 4.5, n)
+    rings = (length * 20 + (sex == "I") * -3
+             + rng.normal(0, 2.0, n))
+    band = np.digitize(rings, [6.0, 10.0])  # 3 classes: young/mid/old
+    rows = [[sex[i], f"{length[i]:.3f}", f"{diameter[i]:.3f}",
+             f"{weight[i]:.3f}", band[i]] for i in range(n)]
+    _write("abalone_like.csv",
+           ["sex", "length", "diameter", "weight", "rings_band"], rows)
+
+
+def pima_like(n=260):
+    rng = np.random.default_rng(43)
+    glucose = rng.uniform(70, 190, n)
+    bmi = rng.uniform(18, 45, n)
+    age = rng.uniform(21, 70, n)
+    pregnancies = rng.integers(0, 10, n)
+    score = 0.035 * glucose + 0.06 * bmi + 0.02 * age - 7.5
+    y = (score + rng.normal(0, 0.8, n) > 0).astype(int)
+    rows = []
+    for i in range(n):
+        r = [f"{glucose[i]:.1f}", f"{bmi[i]:.1f}", f"{age[i]:.0f}",
+             int(pregnancies[i]), y[i]]
+        if rng.random() < 0.06:           # missing cells, PimaIndian-style
+            r[int(rng.integers(0, 3))] = ""
+        rows.append(r)
+    _write("pima_like.csv",
+           ["glucose", "bmi", "age", "pregnancies", "diabetes"], rows)
+
+
+def car_eval_like(n=280):
+    rng = np.random.default_rng(44)
+    buying = rng.choice(["low", "med", "high", "vhigh"], n)
+    maint = rng.choice(["low", "med", "high"], n)
+    doors = rng.choice(["2", "3", "4", "5more"], n)
+    safety = rng.choice(["low", "med", "high"], n)
+    cost = (np.select([buying == "low", buying == "med", buying == "high",
+                       buying == "vhigh"], [0, 1, 2, 3])
+            + np.select([maint == "low", maint == "med", maint == "high"],
+                        [0, 1, 2]))
+    ok = np.select([safety == "low", safety == "med", safety == "high"],
+                   [0, 1, 2]) * 2 - cost
+    noisy = ok + rng.normal(0, 0.9, n)
+    grade = np.digitize(noisy, [-1.0, 1.5])  # unacc / acc / good
+    label = np.take(["unacc", "acc", "good"], grade)
+    rows = [[buying[i], maint[i], doors[i], safety[i], label[i]]
+            for i in range(n)]
+    _write("car_eval_like.csv",
+           ["buying", "maint", "doors", "safety", "grade"], rows)
+
+
+if __name__ == "__main__":
+    banknote_like()
+    abalone_like()
+    pima_like()
+    car_eval_like()

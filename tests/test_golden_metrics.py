@@ -1,0 +1,128 @@
+"""Golden-file metric regression across the learner zoo.
+
+The reference trains six learner families on canned CSVs and fails the build
+when accuracy/AUC drift from a checked-in file
+(``train-classifier/src/test/scala/VerifyTrainClassifier.scala:31-38`` +
+``benchmarkMetrics.csv``). Same harness here: every (dataset x learner) cell
+in ``tests/data/benchmark_metrics.json`` is retrained with fixed seeds and
+compared. Any learner change that moves a metric must consciously re-baseline:
+
+    python -m tests.test_golden_metrics   # regenerates the JSON
+
+Tolerance is 5e-3 absolute — loose enough for cross-platform float noise
+(CPU mesh vs real chip), tight enough that a real regression (>0.5pp of
+accuracy) fails.
+"""
+import json
+import os
+
+import pytest
+
+from mmlspark_tpu.evaluate.compute_model_statistics import ComputeModelStatistics
+from mmlspark_tpu.io.readers import read_csv
+from mmlspark_tpu.train.learners import (
+    LogisticRegression, MLPClassifier, NaiveBayes,
+)
+from mmlspark_tpu.train.train_classifier import TrainClassifier
+from mmlspark_tpu.train.trees import (
+    DecisionTreeClassifier, GBTClassifier, RandomForestClassifier,
+)
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+GOLDEN = os.path.join(DATA, "benchmark_metrics.json")
+TOL = 5e-3
+
+DATASETS = {
+    "banknote_like.csv": ("class", True),
+    "abalone_like.csv": ("rings_band", False),
+    "pima_like.csv": ("diabetes", True),
+    "car_eval_like.csv": ("grade", False),
+}
+
+# Constructors pinned to explicit seeds/sizes so the run is deterministic.
+LEARNERS = {
+    "LogisticRegression": lambda: LogisticRegression(maxIter=60),
+    "DecisionTreeClassification": lambda: DecisionTreeClassifier(maxDepth=5),
+    "RandomForestClassification": lambda: RandomForestClassifier(
+        numTrees=16, maxDepth=5, seed=7),
+    "GradientBoostedTreesClassification": lambda: GBTClassifier(
+        maxIter=20, maxDepth=3),
+    "NaiveBayesClassifier": lambda: NaiveBayes(),
+    "MultilayerPerceptronClassifier": lambda: MLPClassifier(
+        maxIter=200, layers=[16], seed=3),
+}
+BINARY_ONLY = {"GradientBoostedTreesClassification"}  # Spark GBT parity
+
+
+def _cells(dataset: str):
+    _, is_binary = DATASETS[dataset]
+    return [n for n in sorted(LEARNERS) if is_binary or n not in BINARY_ONLY]
+
+
+def _evaluate(dataset: str, learner_name: str) -> dict:
+    frame = read_csv(os.path.join(DATA, dataset), num_partitions=2)
+    model = TrainClassifier(model=LEARNERS[learner_name](),
+                            labelCol=DATASETS[dataset][0]).fit(frame)
+    stats = ComputeModelStatistics()
+    m = stats.transform(model.transform(frame)).collect()
+    out = {"accuracy": round(float(m["accuracy"][0]), 4)}
+    if "AUC" in m:
+        out["AUC"] = round(float(m["AUC"][0]), 4)
+    return out
+
+
+def _golden() -> dict:
+    assert os.path.exists(GOLDEN), (
+        f"{GOLDEN} missing: run `python -m tests.test_golden_metrics`")
+    with open(GOLDEN) as f:
+        return json.load(f)
+
+
+@pytest.mark.parametrize("dataset,learner",
+                         [(d, l) for d in sorted(DATASETS)
+                          for l in _cells(d)])
+def test_metrics_match_golden_file(dataset, learner):
+    expected = _golden()[dataset][learner]
+    got = _evaluate(dataset, learner)
+    for metric, want in expected.items():
+        assert abs(got[metric] - want) <= TOL, (
+            f"{dataset} x {learner}: {metric} drifted "
+            f"{want} -> {got[metric]} (tol {TOL}); if intentional, "
+            f"re-baseline via `python -m tests.test_golden_metrics`")
+
+
+def test_golden_file_covers_all_cells():
+    g = _golden()
+    assert sorted(g) == sorted(DATASETS)
+    for ds, cells in g.items():
+        assert sorted(cells) == _cells(ds), f"{ds} missing learners"
+
+
+def _regenerate() -> None:
+    table = {}
+    for ds in sorted(DATASETS):
+        table[ds] = {}
+        for name in _cells(ds):
+            table[ds][name] = _evaluate(ds, name)
+            print(f"{ds} x {name}: {table[ds][name]}")
+    with open(GOLDEN, "w") as f:
+        json.dump(table, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {GOLDEN}")
+
+
+if __name__ == "__main__":
+    # Baselines are tied to the test environment: the 8-device CPU mesh
+    # (conftest.py), NOT whatever backend the site env defaults to — on a
+    # TPU box the axon backend's numerics differ in the 4th decimal, which
+    # is exactly the drift this harness exists to catch.
+    import os as _os
+    _os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = _os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        _os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.device_count() == 8, "golden baselines need the CPU test mesh"
+    _regenerate()
