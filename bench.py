@@ -1,15 +1,35 @@
-"""Driver benchmark: CIFAR-10 ResNet-20 featurize+train throughput.
+"""Driver benchmark over the five judged configs (BASELINE.json).
 
-Measures images/sec/chip of the FRAMEWORK path (Frame streaming ->
-DistributedTrainer sharded step with the fused Pallas uint8 preprocess ahead
-of the first conv) against an inline PURE-JAX training loop on the same
-model/batch — the BASELINE.json north star ratio (target >= 0.90).
+Headline metric (the north star): CIFAR-10 ResNet-20 featurize+train
+images/sec/chip of the FRAMEWORK path (Frame streaming -> DistributedTrainer
+sharded step with the fused Pallas uint8 preprocess ahead of the first conv)
+against an inline PURE-JAX training loop on the same model/batch
+(target ratio >= 0.90).
+
+The other four judged configs ride along in the same JSON line under
+"configs", each with its own baseline ratio:
+
+- eval:            JaxModel ResNet-20 minibatch scoring (CNTKModel parity)
+                   vs an inline jit apply loop
+- image_featurize: ImageFeaturizer ResNet-50 embeddings — resize + unroll +
+                   intermediate-layer scoring all TIMED — vs the bare
+                   ResNet-50 forward on pre-prepared tensors (featurization
+                   overhead is the thing measured)
+- text:            TextFeaturizer-style tokenize+murmur3-hash (TIMED) +
+                   TextCNN train vs the same train on pre-tokenized ids
+- vit_preprocess:  ViT-B/16 with the fused Pallas uint8 preprocess (uint8
+                   crosses PCIe, normalize fuses into the forward) vs the
+                   conventional unfused host-side fp32 pipeline
 
 Prints exactly one JSON line on stdout:
-  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": R}
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": R,
+   "configs": {name: {"value": ..., "unit": ..., "vs_baseline": ...}}}
+
+Run a subset with --configs train,eval (default: all five).
 """
 from __future__ import annotations
 
+import argparse
 import json
 import sys
 import time
@@ -34,14 +54,12 @@ def _make_data(n_rows: int, seed: int = 0):
 
 
 def _build_model():
-    import jax.numpy as jnp
     from mmlspark_tpu.models.zoo import build_model
     spec = build_model("resnet20_cifar", num_classes=10)
     return spec["module"]
 
 
 def _loss_builder(module, pre):
-    import jax
     import jax.numpy as jnp
     import optax
 
@@ -52,6 +70,24 @@ def _loss_builder(module, pre):
             logits, batch["label"]).mean()
 
     return loss_fn
+
+
+# -- config "train": the headline north-star ---------------------------------
+
+TRIALS = 3
+
+
+def _best_time(run, trials: int = TRIALS) -> float:
+    """Min wall time over `trials` repetitions: the tunnel to the chip has
+    tens-of-ms latency jitter, so short timed regions need best-of-k for a
+    stable throughput number."""
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
 
 
 def bench_framework(images: np.ndarray, labels: np.ndarray) -> float:
@@ -93,12 +129,14 @@ def bench_framework(images: np.ndarray, labels: np.ndarray) -> float:
         state, metrics = trainer.train_step(state, trainer.put_batch(next(it)), rng)
     jax.block_until_ready(metrics["loss"])
 
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        state, metrics = trainer.train_step(state, trainer.put_batch(next(it)), rng)
-    jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
-    return STEPS * BATCH / dt
+    def run():
+        nonlocal state
+        for _ in range(STEPS):
+            state, metrics = trainer.train_step(
+                state, trainer.put_batch(next(it)), rng)
+        jax.block_until_ready(metrics["loss"])
+
+    return STEPS * BATCH / _best_time(run)
 
 
 def bench_pure_jax(images: np.ndarray, labels: np.ndarray) -> float:
@@ -142,25 +180,352 @@ def bench_pure_jax(images: np.ndarray, labels: np.ndarray) -> float:
                                        jnp.asarray(x), jnp.asarray(y))
     jax.block_until_ready(loss)
 
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        x, y = next(it)
-        params, opt_state, loss = step(params, opt_state,
-                                       jnp.asarray(x), jnp.asarray(y))
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    return STEPS * BATCH / dt
+    def run():
+        nonlocal params, opt_state
+        for _ in range(STEPS):
+            x, y = next(it)
+            params, opt_state, loss = step(params, opt_state,
+                                           jnp.asarray(x), jnp.asarray(y))
+        jax.block_until_ready(loss)
+
+    return STEPS * BATCH / _best_time(run)
 
 
-def main() -> None:
+def config_train() -> dict:
     images, labels = _make_data(n_rows=4096)
     base_ips = bench_pure_jax(images, labels)
     fw_ips = bench_framework(images, labels)
+    return {"value": round(fw_ips, 2), "unit": "images/sec/chip",
+            "vs_baseline": round(fw_ips / base_ips, 4)}
+
+
+# -- config "eval": JaxModel minibatch scoring (CNTKModel parity) ------------
+
+def config_eval() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.core.frame import Frame
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.models.zoo import build_model
+
+    n, bs = 4096, 512
+    images, _ = _make_data(n_rows=n, seed=1)
+    feats = images.astype(np.float32)
+
+    jm = JaxModel(inputCol="features", outputCol="scored", miniBatchSize=bs)
+    jm.set_model("resnet20_cifar", num_classes=10, seed=0)
+    frame = Frame.from_dict({"features": feats}, num_partitions=8)
+
+    jm.transform(frame)  # warmup: compile + one full pass
+    fw_ips = n / _best_time(lambda: jm.transform(frame))
+
+    # baseline: bare jit apply over numpy slices, same sync pattern
+    spec = build_model("resnet20_cifar", num_classes=10)
+    module = spec["module"]
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1,) + IMAGE_SHAPE, jnp.float32))
+    jitted = jax.jit(lambda p, x: module.apply(p, x))
+    apply = lambda x: jitted(params, x)
+    x4 = feats.reshape((-1,) + IMAGE_SHAPE)
+
+    def run_once():
+        outs = []
+        for off in range(0, n, bs):
+            y = apply(jnp.asarray(x4[off:off + bs]))
+            outs.append(np.asarray(jax.device_get(y)))
+        return outs
+
+    run_once()
+    base_ips = n / _best_time(run_once)
+    return {"value": round(fw_ips, 2), "unit": "images/sec/chip",
+            "vs_baseline": round(fw_ips / base_ips, 4)}
+
+
+# -- config "image_featurize": ImageFeaturizer ResNet-50 embeddings ----------
+
+def config_image_featurize() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.core.frame import Frame
+    from mmlspark_tpu.core.schema import ColumnSchema, DType, ImageValue
+    from mmlspark_tpu.image.featurizer import ImageFeaturizer
+    from mmlspark_tpu.models.zoo import build_model
+
+    n, bs, src, dst = 128, 32, 256, 224
+    rng = np.random.default_rng(2)
+    raw = rng.integers(0, 256, size=(n, src, src, 3), dtype=np.uint8)
+    imgs = np.empty(n, dtype=object)
+    for i in range(n):
+        imgs[i] = ImageValue(path=f"mem://bench/{i}", data=raw[i])
+    frame = Frame.from_dict({"row": np.arange(n)}, num_partitions=4)
+    frame = frame.with_column_values(ColumnSchema("image", DType.IMAGE), imgs)
+
+    fz = ImageFeaturizer(inputCol="image", outputCol="features",
+                         cutOutputLayers=1, miniBatchSize=bs)
+    fz.set_model("resnet50", num_classes=1000, seed=0)
+
+    fz.transform(frame)  # warmup
+    # TIMED: resize 256->224 + unroll + pool-layer scoring
+    fw_ips = n / _best_time(lambda: fz.transform(frame))
+
+    # baseline: the bare ResNet-50 forward on pre-prepared fp32 tensors —
+    # the ratio exposes what the featurization pipeline costs on top
+    spec = build_model("resnet50", num_classes=1000)
+    module = spec["module"]
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, dst, dst, 3), jnp.float32))
+    jitted = jax.jit(lambda p, x: module.apply(p, x))
+    apply = lambda x: jitted(params, x)
+    pre = rng.normal(0, 1, size=(n, dst, dst, 3)).astype(np.float32)
+
+    def run_once():
+        for off in range(0, n, bs):
+            jax.device_get(apply(jnp.asarray(pre[off:off + bs])))
+
+    run_once()
+    base_ips = n / _best_time(run_once)
+    return {"value": round(fw_ips, 2), "unit": "images/sec/chip",
+            "vs_baseline": round(fw_ips / base_ips, 4)}
+
+
+# -- config "text": TextFeaturizer tokenize+hash + TextCNN train -------------
+
+_SEQ_LEN = 128
+_VOCAB = 1 << 15
+_TEXT_STEPS = 40
+
+
+def _make_reviews(n: int, seed: int = 3):
+    # Amazon-review-shaped: 40-120 tokens from a 20k vocabulary
+    rng = np.random.default_rng(seed)
+    vocab = np.array([f"word{i}" for i in range(20000)])
+    texts = [" ".join(rng.choice(vocab, rng.integers(40, 120)))
+             for _ in range(n)]
+    labels = rng.integers(0, 2, n).astype(np.int32)
+    return texts, labels
+
+
+def _tokenize_hash(texts) -> np.ndarray:
+    """TextFeaturizer's hot path: regex tokenize + Spark-parity murmur3 ->
+    fixed-length id sequences (0 = pad). Natural text repeats its
+    vocabulary, so hash unique terms once and scatter via inverse map."""
+    import re
+    from mmlspark_tpu.ops.hashing import murmur3_batch
+    tok = re.compile(r"\w+")
+    rows = [tok.findall(t.lower()) for t in texts]
+    flat = np.array([w for r in rows for w in r], dtype=object)
+    uniq, inverse = np.unique(flat, return_inverse=True)
+    ids = (murmur3_batch(list(uniq)) % (_VOCAB - 1) + 1)[inverse]
+    out = np.zeros((len(rows), _SEQ_LEN), np.int32)
+    off = 0
+    for i, r in enumerate(rows):
+        k = min(len(r), _SEQ_LEN)
+        out[i, :k] = ids[off:off + k]
+        off += len(r)
+    return out
+
+
+def _textcnn_trainer():
+    import optax
+    from mmlspark_tpu.models.zoo import build_model
+    from mmlspark_tpu.parallel.trainer import DistributedTrainer
+    import jax.numpy as jnp
+
+    spec = build_model("textcnn", vocab_size=_VOCAB, num_classes=2,
+                       seq_len=_SEQ_LEN)
+    module = spec["module"]
+
+    def loss_fn(params, batch, rng):
+        import optax as _optax
+        logits = module.apply(params, batch["ids"]).astype(jnp.float32)
+        return _optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean()
+
+    return module, DistributedTrainer(loss_fn, optax.adam(1e-3))
+
+
+def config_text() -> dict:
+    """Featurize+train, both sides TIMED end to end. The framework streams
+    per-batch featurization through DevicePrefetcher so host tokenize/hash
+    overlaps device steps; the baseline is the reference's two-phase shape
+    (featurize the whole dataset, then train — ``CNTKLearner.fit`` writes
+    the featurized set out before the ``cntk`` process starts)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = _TEXT_STEPS * BATCH
+    texts, labels = _make_reviews(n)
+
+    module, trainer = _textcnn_trainer()
+    state = trainer.init(
+        lambda: module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, _SEQ_LEN), jnp.int32)))
+    rng = jax.random.PRNGKey(1)
+
+    # warmup: compile with a throwaway batch
+    warm_ids = _tokenize_hash(texts[:BATCH])
+    for _ in range(WARMUP):
+        state, metrics = trainer.train_step(
+            state, trainer.put_batch(
+                {"ids": warm_ids, "label": labels[:BATCH]}), rng)
+    jax.block_until_ready(metrics["loss"])
+
+    # framework: featurize per batch INSIDE the prefetcher's producer
+    # thread; tokenize+hash of batch k+1 overlaps the device step on k
+    def host_batches():
+        for s in range(_TEXT_STEPS):
+            sl = slice(s * BATCH, (s + 1) * BATCH)
+            yield {"ids": _tokenize_hash(texts[sl]), "label": labels[sl]}
+
+    def run_fw():
+        nonlocal state
+        state, _ = trainer.fit(state, host_batches(), rng,
+                               collect_losses=False)
+
+    fw_rps = n / _best_time(run_fw)
+
+    # baseline: featurize everything, then train (two serial phases)
+    module_b, trainer_b = _textcnn_trainer()
+    state_b = trainer_b.init(
+        lambda: module_b.init(jax.random.PRNGKey(0),
+                              jnp.zeros((1, _SEQ_LEN), jnp.int32)))
+    for _ in range(WARMUP):
+        state_b, metrics = trainer_b.train_step(
+            state_b, trainer_b.put_batch(
+                {"ids": warm_ids, "label": labels[:BATCH]}), rng)
+    jax.block_until_ready(metrics["loss"])
+    def run_base():
+        nonlocal state_b
+        ids = _tokenize_hash(texts)
+        for s in range(_TEXT_STEPS):
+            sl = slice(s * BATCH, (s + 1) * BATCH)
+            state_b, metrics = trainer_b.train_step(
+                state_b,
+                trainer_b.put_batch({"ids": ids[sl], "label": labels[sl]}),
+                rng)
+        jax.block_until_ready(metrics["loss"])
+
+    base_rps = n / _best_time(run_base)
+    return {"value": round(fw_rps, 2), "unit": "rows/sec/chip",
+            "vs_baseline": round(fw_rps / base_rps, 4)}
+
+
+# -- config "vit_preprocess": fused Pallas uint8 pipe into ViT-B/16 ----------
+
+def config_vit_preprocess() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.models.zoo import build_model
+    from mmlspark_tpu.ops.pallas_preprocess import make_preprocess_fn
+
+    size, bs, steps = 224, 32, 8
+    shape = (size, size, 3)
+    n_pix = int(np.prod(shape))
+    rng = np.random.default_rng(4)
+    u8 = rng.integers(0, 256, size=(bs, n_pix), dtype=np.uint8)
+
+    spec = build_model("vit_b16", num_classes=1000)
+    module = spec["module"]
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1,) + shape, jnp.float32))
+
+    # framework path: uint8 crosses the wire; Pallas normalize fuses into
+    # the SAME jit as the ViT forward (no fp32 image HBM round trip)
+    pre = make_preprocess_fn(shape, mean=(127.5,) * 3, std=(127.5,) * 3)
+
+    @jax.jit
+    def fused_jit(p, u8_flat):
+        return module.apply(p, pre(u8_flat))
+
+    def fused(u8_flat):
+        return fused_jit(params, u8_flat)
+
+    def run_fused():
+        out = None
+        for _ in range(steps):
+            out = fused(jnp.asarray(u8))
+        jax.block_until_ready(out)
+
+    run_fused()
+    fw_ips = steps * bs / _best_time(run_fused)
+
+    # baseline: conventional unfused pipeline — normalize on host in fp32
+    # (the OpenCV-style CPU preprocess), ship 4x the bytes, then forward
+    @jax.jit
+    def forward_jit(p, x):
+        return module.apply(p, x.astype(jnp.bfloat16))
+
+    def forward(x):
+        return forward_jit(params, x)
+
+    def run_unfused():
+        out = None
+        for _ in range(steps):
+            x = (u8.astype(np.float32) - 127.5) / 127.5
+            out = forward(jnp.asarray(x.reshape((bs,) + shape)))
+        jax.block_until_ready(out)
+
+    run_unfused()
+    base_ips = steps * bs / _best_time(run_unfused)
+    return {"value": round(fw_ips, 2), "unit": "images/sec/chip",
+            "vs_baseline": round(fw_ips / base_ips, 4)}
+
+
+CONFIGS = {
+    "train": config_train,
+    "eval": config_eval,
+    "image_featurize": config_image_featurize,
+    "text": config_text,
+    "vit_preprocess": config_vit_preprocess,
+}
+
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache next to the repo: ViT-B/16 and
+    ResNet-50 compiles take minutes through a remote-compile tunnel; the
+    second bench invocation on the same machine must not pay them again."""
+    import os
+    import jax
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jaxlib without the persistent cache: just slower
+
+
+def main() -> None:
+    _enable_compile_cache()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default=",".join(CONFIGS),
+                    help="comma list of: " + ",".join(CONFIGS))
+    args = ap.parse_args()
+    names = [c.strip() for c in args.configs.split(",") if c.strip()]
+    unknown = sorted(set(names) - set(CONFIGS))
+    if unknown:
+        raise SystemExit(f"unknown configs {unknown}; have {sorted(CONFIGS)}")
+
+    if not names:
+        raise SystemExit("no configs selected")
+
+    results = {}
+    for name in names:
+        results[name] = CONFIGS[name]()
+        print(f"# {name}: {results[name]}", file=sys.stderr)
+
+    # headline = the north-star train config when it ran; otherwise name
+    # the metric after the config it actually carries
+    head_name = "train" if "train" in results else names[0]
+    head = results[head_name]
+    metric = ("cifar10_resnet20_train_images_per_sec_per_chip"
+              if head_name == "train" else f"bench_{head_name}")
     print(json.dumps({
-        "metric": "cifar10_resnet20_train_images_per_sec_per_chip",
-        "value": round(fw_ips, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(fw_ips / base_ips, 4),
+        "metric": metric,
+        "value": head["value"],
+        "unit": head["unit"],
+        "vs_baseline": head["vs_baseline"],
+        "configs": results,
     }))
 
 

@@ -162,11 +162,26 @@ class Params:
         raise ParamException(f"{cls.__name__} has no param {name!r}")
 
     # -- get/set -----------------------------------------------------------
+    @staticmethod
+    def _unchanged(cur, new) -> bool:
+        if cur is new:
+            return True
+        try:
+            return bool(cur == new)
+        except Exception:  # ambiguous comparisons (arrays) -> treat as changed
+            return False
+
     def set(self, param, value) -> "Params":
         if isinstance(param, str):
             param = self.get_param(param)
-        self._paramMap[param.name] = param.validate(value)
-        self._jit_cache = None  # compiled closures may capture param values
+        value = param.validate(value)
+        # compiled closures may capture param values — but a no-op set must
+        # not throw away a 20-40s TPU compile (e.g. re-stamping the same
+        # inputCol on a cached scoring model every transform() call)
+        if not (param.name in self._paramMap
+                and self._unchanged(self._paramMap[param.name], value)):
+            self._jit_cache = None
+        self._paramMap[param.name] = value
         return self
 
     def set_params(self, **kwargs) -> "Params":

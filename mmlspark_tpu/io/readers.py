@@ -55,10 +55,16 @@ def _sample(items: List, ratio: float, seed: int) -> List:
     return [x for x in items if rng.random() < ratio]
 
 
-def read_binary_files(path: str, recursive: bool = False,
-                      sample_ratio: float = 1.0, inspect_zip: bool = True,
-                      seed: int = 0, num_partitions: int = 1) -> Frame:
-    """Frame with (path, bytes) columns — reference BinaryFileSchema."""
+def iter_binary_entries(path: str, recursive: bool = False,
+                        sample_ratio: float = 1.0, inspect_zip: bool = True,
+                        seed: int = 0):
+    """Lazily yield ``(path, bytes)`` one entry at a time.
+
+    The streaming core under both the eager Frame readers and the chunked
+    ``stream_*`` APIs: only the file LISTING is materialized up front; each
+    blob is read (and each zip opened) as the consumer pulls it, so a
+    terabyte image corpus streams through O(one file) of memory.
+    """
     if not 0.0 < sample_ratio <= 1.0:
         raise ValueError(f"sample_ratio must be in (0, 1], got {sample_ratio}")
     all_files = _list_files(path, recursive)
@@ -69,8 +75,6 @@ def read_binary_files(path: str, recursive: bool = False,
             if inspect_zip and f.endswith(".zip") and zipfile.is_zipfile(f)}
     files = sorted(_sample([f for f in all_files if f not in zips],
                            sample_ratio, seed) + list(zips))
-    paths: List[str] = []
-    blobs: List[bytes] = []
     for f in files:
         if f in zips:
             with zipfile.ZipFile(f) as z:
@@ -79,12 +83,75 @@ def read_binary_files(path: str, recursive: bool = False,
                 # zip entries are themselves subject to the sample ratio
                 # (reference ZipIterator seeded sampling)
                 for n in _sample(names, sample_ratio, seed):
-                    paths.append(f"{f}/{n}")
-                    blobs.append(z.read(n))
+                    yield f"{f}/{n}", z.read(n)
         else:
             with open(f, "rb") as fh:
-                paths.append(f)
-                blobs.append(fh.read())
+                yield f, fh.read()
+
+
+def stream_binary_files(path: str, recursive: bool = False,
+                        sample_ratio: float = 1.0, inspect_zip: bool = True,
+                        seed: int = 0, chunk_rows: int = 256):
+    """Yield host-batch dicts ``{"path", "bytes"}`` of <= chunk_rows entries.
+
+    The lazy counterpart of :func:`read_binary_files` for corpora that do
+    not fit in memory — chunks feed DevicePrefetcher / DistributedTrainer
+    directly, replacing the reference's write-to-shared-FS hand-off
+    (``CNTKLearner.scala:93-125``) with bounded-memory streaming.
+    """
+    paths: List[str] = []
+    blobs: List[bytes] = []
+    for p, b in iter_binary_entries(path, recursive, sample_ratio,
+                                    inspect_zip, seed):
+        paths.append(p)
+        blobs.append(b)
+        if len(paths) >= chunk_rows:
+            yield {"path": _object_array(paths), "bytes": _object_array(blobs)}
+            paths, blobs = [], []
+    if paths:
+        yield {"path": _object_array(paths), "bytes": _object_array(blobs)}
+
+
+def stream_images(path: str, recursive: bool = False,
+                  sample_ratio: float = 1.0, inspect_zip: bool = True,
+                  seed: int = 0, chunk_rows: int = 256,
+                  decode_threads: int = 8):
+    """Yield ``{"path", "image"}`` chunks of decoded images, lazily.
+
+    Decode runs per chunk through the native threaded pool; undecodable
+    entries are dropped within their chunk (``ImageReader.scala:55-59``
+    semantics). Memory high-water mark is one chunk of decoded images.
+    """
+    for chunk in stream_binary_files(path, recursive, sample_ratio,
+                                     inspect_zip, seed, chunk_rows):
+        decoded = _decode_blobs(list(chunk["bytes"]),
+                                n_threads=decode_threads)
+        images, keep = [], []
+        for pth, arr in zip(chunk["path"], decoded):
+            if arr is not None:
+                images.append(ImageValue(path=pth, data=arr))
+                keep.append(pth)
+        if images:
+            yield {"path": _object_array(keep), "image": _object_array(images)}
+
+
+def _object_array(values: Sequence) -> np.ndarray:
+    arr = np.empty(len(values), dtype=np.object_)
+    for i, v in enumerate(values):
+        arr[i] = v
+    return arr
+
+
+def read_binary_files(path: str, recursive: bool = False,
+                      sample_ratio: float = 1.0, inspect_zip: bool = True,
+                      seed: int = 0, num_partitions: int = 1) -> Frame:
+    """Frame with (path, bytes) columns — reference BinaryFileSchema."""
+    paths: List[str] = []
+    blobs: List[bytes] = []
+    for p, b in iter_binary_entries(path, recursive, sample_ratio,
+                                    inspect_zip, seed):
+        paths.append(p)
+        blobs.append(b)
     frame = Frame.from_dict({"path": paths, "bytes": blobs},
                             schema=Schema([
                                 ColumnSchema("path", DType.STRING),
@@ -128,13 +195,8 @@ def read_images(path: str, recursive: bool = False, sample_ratio: float = 1.0,
                 continue
             images.append(ImageValue(path=pth, data=arr))
             keep_paths.append(pth)
-        img_arr = np.empty(len(images), dtype=np.object_)
-        for i, v in enumerate(images):
-            img_arr[i] = v
-        path_arr = np.empty(len(keep_paths), dtype=np.object_)
-        for i, v in enumerate(keep_paths):
-            path_arr[i] = v
-        parts.append({"path": path_arr, "image": img_arr})
+        parts.append({"path": _object_array(keep_paths),
+                      "image": _object_array(images)})
     schema = Schema([
         ColumnSchema("path", DType.STRING),
         ColumnSchema("image", DType.IMAGE,

@@ -57,7 +57,9 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
             dtype = jnp.int32 if spec.get("input_dtype") == "int32" else jnp.float32
             x = jnp.zeros(shape, dtype)
             params = module.init(jax.random.PRNGKey(seed), x)
-        self._state = {"params": _to_plain(params)}
+        # _set_state (not a bare assignment) so a previously compiled
+        # closure over OLD params is invalidated
+        self._set_state({"params": _to_plain(params)})
         return self
 
     # -- internals ---------------------------------------------------------
@@ -73,6 +75,11 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
     def _build_apply(self):
         spec = self._spec()
         module = spec["module"]
+        # params are ARGUMENTS of the jitted function, never closure
+        # captures: closed-over arrays inline into the HLO as constants,
+        # which for a ResNet-50/ViT-B bloats the program by the full
+        # parameter size and multiplies compile time (or overflows
+        # remote-compile request limits outright)
         params = jax.tree_util.tree_map(jnp.asarray, self._state["params"])
         node = self.outputNodeName
 
@@ -84,34 +91,51 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         if mu is not None:
             mu_d = jnp.asarray(mu)
             sigma_d = jnp.asarray(self._state["input_sigma"])
-            pre = lambda x: (x - mu_d) / sigma_d
+            pre = lambda x: (_to_float(x) - mu_d) / sigma_d
         else:
-            pre = lambda x: x
+            pre = _to_float
 
         if not node:
-            @jax.jit
-            def apply(x):
-                return module.apply(params, pre(x))
-            return apply, None
+            jitted = jax.jit(lambda p, x: module.apply(p, pre(x)))
+            return (lambda x: jitted(params, x)), None
 
         from mmlspark_tpu.models.zoo.resnet import apply_with_intermediates
 
+        def select(inters):
+            return [v for k, v in sorted(inters.items())
+                    if k == node or k.endswith("/" + node)]
+
+        # Probe (shape-only, no compile) whether the node is an explicitly
+        # sown layer; capture_intermediates=True records EVERY submodule
+        # output and costs ~3x at runtime, so it is the fallback, not the
+        # default.
+        in_shape = tuple(spec["input_shape"])
+        dt = jnp.int32 if spec.get("input_dtype") == "int32" else jnp.float32
+        probe = jax.eval_shape(
+            lambda x: apply_with_intermediates(module, params, pre(x))[1],
+            jax.ShapeDtypeStruct((1,) + in_shape, dt))
+        capture_all = not select(probe)
+
         @jax.jit
-        def apply(x):
-            _, inters = apply_with_intermediates(module, params, pre(x))
-            matches = [v for k, v in sorted(inters.items())
-                       if k == node or k.endswith("/" + node)]
+        def jitted(p, x):
+            _, inters = apply_with_intermediates(module, p, pre(x),
+                                                 capture_all=capture_all)
+            matches = select(inters)
             if not matches:
                 raise SchemaError(
                     f"output node {node!r} not found; have {sorted(inters)}")
             return matches[0]
-        return apply, node
+        return (lambda x: jitted(params, x)), node
 
     def _coerce_batch(self, arr: np.ndarray, spec) -> np.ndarray:
-        """Host-side input coercion (reference UDFs :195-212) + reshape."""
+        """Host-side input coercion (reference UDFs :195-212) + reshape.
+        uint8 inputs stay uint8 — they cross host->HBM at 1/4 the bytes and
+        cast to float INSIDE the jit (the fused-preprocess fast path)."""
         in_shape = tuple(spec["input_shape"])
         want_int = spec.get("input_dtype") == "int32"
-        arr = np.asarray(arr, dtype=np.int32 if want_int else np.float32)
+        arr = np.asarray(arr)
+        if arr.dtype != np.uint8 or want_int:
+            arr = arr.astype(np.int32 if want_int else np.float32)
         if arr.ndim == 2 and len(in_shape) > 1:
             if int(np.prod(in_shape)) != arr.shape[1]:
                 raise SchemaError(
@@ -143,6 +167,12 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
 
     def transform_schema(self, schema):
         return schema.add(ColumnSchema(self.outputCol, DType.VECTOR, None))
+
+
+def _to_float(x):
+    """uint8 wire format -> float32 on device; other dtypes untouched
+    (int32 token models must stay integer)."""
+    return x.astype(jnp.float32) if x.dtype == jnp.uint8 else x
 
 
 def _to_plain(tree):

@@ -138,10 +138,17 @@ def resnet50(num_classes: int = 1000, dtype=jnp.bfloat16):
     )
 
 
-def apply_with_intermediates(module: nn.Module, params, x):
-    """Forward returning (logits, {layer_name: activation}) for layer selection."""
-    logits, state = module.apply(params, x, capture_intermediates=True,
-                                 mutable=["intermediates"])
+def apply_with_intermediates(module: nn.Module, params, x,
+                             capture_all: bool = False):
+    """Forward returning (logits, {layer_name: activation}) for layer
+    selection. By default only EXPLICITLY sown layers are recorded (the
+    zoo's named feature layers) — ``capture_intermediates=True`` records
+    every submodule output, which costs ~3x at runtime on a ResNet-50 even
+    after DCE; pass ``capture_all=True`` only when the requested node is
+    not an explicit sow."""
+    kwargs = {"capture_intermediates": True} if capture_all else {}
+    logits, state = module.apply(params, x, mutable=["intermediates"],
+                                 **kwargs)
     inters = {}
 
     def walk(prefix, tree):
@@ -151,6 +158,7 @@ def apply_with_intermediates(module: nn.Module, params, x):
             else:
                 inters[f"{prefix}{k}".replace("__call__", "out").rstrip("/")] = \
                     v[0] if isinstance(v, tuple) else v
-    walk("", state["intermediates"])
+    # modules that sow nothing return a state dict without the collection
+    walk("", state.get("intermediates", {}))
     inters["head"] = logits
     return logits, inters

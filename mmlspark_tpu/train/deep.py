@@ -275,19 +275,21 @@ class DeepClassifierModel(HasFeaturesCol, HasLabelCol, Model):
         spec = self._spec()
         module = spec["module"]
         in_shape = tuple(spec["input_shape"])
+        # params are jit ARGUMENTS: closure-captured arrays inline into the
+        # HLO as constants and bloat compiles by the full parameter size
         params = jax.tree_util.tree_map(jnp.asarray, self._state["params"])
         standardize = bool(self._state.get("standardize", True))
         mu = jnp.asarray(self._state["mu"])
         sigma = jnp.asarray(self._state["sigma"])
 
         @jax.jit
-        def f(X):
-            x = (X - mu) / sigma if standardize else X
+        def f(p, mu_, sigma_, X):
+            x = (X - mu_) / sigma_ if standardize else X
             if len(in_shape) > 1:
                 x = x.reshape((x.shape[0],) + in_shape)
-            logits = module.apply(params, x)
+            logits = module.apply(p, x)
             return logits, jax.nn.softmax(logits, axis=-1)
-        return f
+        return lambda X: f(params, mu, sigma, X)
 
     def transform(self, frame: Frame) -> Frame:
         return _score_classifier(self, frame)

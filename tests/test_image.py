@@ -254,3 +254,49 @@ def test_fused_normalize_matches_numpy(rng):
     ref = (u8.reshape(5, 6, 6, 3).astype(np.float32)
            - np.array([1, 2, 3], np.float32)) / 2.0
     np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+# -- streaming readers (bounded-memory ingestion) ---------------------------
+
+def test_stream_binary_files_matches_eager(tmp_path, rng):
+    from mmlspark_tpu.io.readers import stream_binary_files
+    d = make_image_dir(tmp_path, rng, n=7)
+    zpath = tmp_path / "imgs" / "extra.zip"
+    with zipfile.ZipFile(zpath, "w") as z:
+        z.writestr("a.bin", b"alpha")
+        z.writestr("dir/b.bin", b"beta")
+    eager = read_binary_files(str(d), recursive=True)
+    chunks = list(stream_binary_files(str(d), recursive=True, chunk_rows=3))
+    assert all(len(c["path"]) <= 3 for c in chunks)
+    assert len(chunks) >= 3  # actually chunked, not one blob
+    streamed_paths = [p for c in chunks for p in c["path"]]
+    streamed_blobs = [b for c in chunks for b in c["bytes"]]
+    assert streamed_paths == list(eager.column("path"))
+    assert streamed_blobs == list(eager.column("bytes"))
+
+
+def test_stream_binary_files_is_lazy(tmp_path):
+    """Only the listing happens up front: a file that disappears after the
+    first chunk was consumed must not have been read eagerly."""
+    from mmlspark_tpu.io.readers import stream_binary_files
+    for i in range(6):
+        (tmp_path / f"f{i}.bin").write_bytes(bytes([i]) * 4)
+    it = stream_binary_files(str(tmp_path), chunk_rows=2)
+    first = next(it)
+    assert len(first["path"]) == 2
+    os.remove(tmp_path / "f5.bin")  # not yet consumed -> not yet opened
+    with pytest.raises(FileNotFoundError):
+        for _ in it:
+            pass
+
+
+def test_stream_images_drops_undecodable_and_matches_eager(tmp_path, rng):
+    from mmlspark_tpu.io.readers import stream_images
+    d = make_image_dir(tmp_path, rng, n=6)  # includes junk.txt
+    eager = read_images(str(d), recursive=True)
+    chunks = list(stream_images(str(d), recursive=True, chunk_rows=2))
+    streamed_paths = [p for c in chunks for p in c["path"]]
+    assert streamed_paths == list(eager.column("path"))
+    for c in chunks:
+        for img in c["image"]:
+            assert img.data.dtype == np.uint8 and img.data.ndim == 3

@@ -155,3 +155,24 @@ def test_local_repo_hash_verification(tmp_path):
         repo.get_model_path(schema)
     with pytest.raises(KeyError):
         repo.find_by_name("ghost")
+
+
+def test_set_model_invalidates_compiled_closure():
+    """set_model with new params must not keep scoring with the OLD weights:
+    the no-op-set optimization in Params.set skips jit invalidation, so
+    set_model itself has to clear the cached closure."""
+    from mmlspark_tpu.core.frame import Frame
+    from mmlspark_tpu.models.jax_model import JaxModel
+    rng = np.random.default_rng(0)
+    frame = Frame.from_dict(
+        {"features": rng.normal(size=(8, 6)).astype(np.float32)})
+    jm = JaxModel(inputCol="features", outputCol="out", miniBatchSize=8)
+    jm.set_model("mlp_tabular", input_dim=6, num_classes=3, seed=0)
+    out0 = np.asarray(jm.transform(frame).column("out"))
+    jm.set_model("mlp_tabular", input_dim=6, num_classes=3, seed=123)
+    out1 = np.asarray(jm.transform(frame).column("out"))
+    fresh = JaxModel(inputCol="features", outputCol="out", miniBatchSize=8)
+    fresh.set_model("mlp_tabular", input_dim=6, num_classes=3, seed=123)
+    expect = np.asarray(fresh.transform(frame).column("out"))
+    assert not np.allclose(out0, out1)  # weights actually changed
+    np.testing.assert_allclose(out1, expect, rtol=1e-6)
