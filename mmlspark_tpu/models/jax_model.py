@@ -147,15 +147,40 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         spec = self._spec()
         apply, _ = self._cached_jit(lambda: self._build_apply())
         bs = self.miniBatchSize
-        outs = []
+        # Async scoring loop: a batch's transfer + forward is DISPATCHED
+        # before earlier results are fetched (JAX dispatch returns
+        # immediately), so host->device DMA overlaps compute instead of the
+        # reference's strictly serial fill/evaluate/copy-back minibatch
+        # loop (CNTKModel.scala:50-104). Outputs retire in bounded windows:
+        # one device-side concat + ONE transfer per window — a round trip
+        # per window instead of per batch, without accumulating the whole
+        # output (which for intermediate-layer extraction is NOT small) or
+        # building a concat whose operand count scales with the dataset.
+        window = 32            # output batches fetched per round trip
+        in_flight = 8          # bound dispatched-but-unexecuted inputs (HBM)
+        dev_outs: list = []
+        outs: list = []
+
+        def retire():
+            if not dev_outs:
+                return
+            stacked = dev_outs[0] if len(dev_outs) == 1 \
+                else jnp.concatenate(dev_outs, axis=0)
+            outs.append(np.asarray(jax.device_get(stacked)))
+            dev_outs.clear()
+
         for batch in frame.batches(bs, cols=[self.inputCol]):
             x = self._coerce_batch(batch[self.inputCol], spec)
             n = x.shape[0]
             if n < bs:  # pad final batch: keep ONE compiled shape
                 pad = np.zeros((bs - n,) + x.shape[1:], x.dtype)
                 x = np.concatenate([x, pad], axis=0)
-            y = np.asarray(jax.device_get(apply(jnp.asarray(x))))
-            outs.append(y[:n])
+            dev_outs.append(apply(jnp.asarray(x))[:n])
+            if len(dev_outs) >= window:
+                retire()
+            elif len(dev_outs) >= in_flight:
+                dev_outs[-in_flight].block_until_ready()
+        retire()
         out = np.concatenate(outs, axis=0) if outs \
             else np.zeros((0, 1), np.float32)
         if out.ndim == 1:
