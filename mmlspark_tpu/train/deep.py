@@ -93,11 +93,17 @@ def _build_spec(architecture: str, arch_args: Dict[str, Any],
     return build_model(architecture, **args), args
 
 
-@register_stage
-class DeepClassifier(JaxEstimator):
-    """Distributed deep-net classifier over a device mesh (CNTKLearner parity)."""
+class _DeepEstimatorBase(JaxEstimator):
+    """Shared distributed streaming-fit machinery for the deep Estimators.
+
+    Subclasses provide the task head: label dtype, output width, and the
+    per-batch loss — everything else (mesh resolution, batch quantum,
+    streaming stats, checkpoint/resume with seeded epoch replay, prefetch,
+    metric logging, profiling) is one implementation.
+    """
 
     hints = FeaturizeHints(one_hot=True, num_features=1 << 12)
+    _y_dtype = np.int32
 
     architecture = StringParam(
         "architecture", "model zoo architecture name", "mlp_tabular")
@@ -129,16 +135,27 @@ class DeepClassifier(JaxEstimator):
     # Stats and padding come from JaxEstimator._streaming_stats / _pad_xyw
     # (learners.py) — one implementation of the streaming moment pass and the
     # pad-and-mask batch builder shared by every streaming learner.
-    @staticmethod
-    def _pad_batch(hb: Dict[str, np.ndarray], fcol: str, lcol: str,
+    @classmethod
+    def _pad_batch(cls, hb: Dict[str, np.ndarray], fcol: str, lcol: str,
                    bs: int) -> Dict[str, np.ndarray]:
         """Fixed-shape training batch: zero-pad the tail, mask it via `w`."""
         from mmlspark_tpu.train.learners import _pad_xyw
-        x, y, w = _pad_xyw(hb, fcol, lcol, bs, np.int32)
+        x, y, w = _pad_xyw(hb, fcol, lcol, bs, cls._y_dtype)
         return {"x": x, "y": y, "w": w}
 
+    # -- task hooks (subclass responsibility) -------------------------------
+    def _n_out(self, frame: Frame, ymax, ymu, ysigma) -> int:
+        raise NotImplementedError
+
+    def _make_loss(self, module, prep, ymu, ysigma):
+        raise NotImplementedError
+
+    def _build_fitted(self, fcol, lcol, resolved_args, state_arrays, n_out,
+                      ymu, ysigma):
+        raise NotImplementedError
+
     # -- fit ---------------------------------------------------------------
-    def fit(self, frame: Frame) -> "DeepClassifierModel":
+    def fit(self, frame: Frame):
         from mmlspark_tpu.parallel.trainer import DistributedTrainer
 
         fcol, lcol = self.featuresCol, self.labelCol
@@ -151,11 +168,11 @@ class DeepClassifier(JaxEstimator):
         quantum = dp * self.accumSteps
         bs = int(math.ceil(self.batchSize / quantum) * quantum)
 
-        n, d, mu, sigma, ymax, _, _ = self._streaming_stats(frame)
-        n_classes = self._num_classes(frame, ymax)
+        n, d, mu, sigma, ymax, ymu, ysigma = self._streaming_stats(frame)
+        n_out = self._n_out(frame, ymax, ymu, ysigma)
 
         spec, resolved_args = _build_spec(
-            self.architecture, self.get("architectureArgs"), d, n_classes)
+            self.architecture, self.get("architectureArgs"), d, n_out)
         module = spec["module"]
         in_shape = tuple(spec["input_shape"])
         standardize = self.standardize
@@ -168,12 +185,7 @@ class DeepClassifier(JaxEstimator):
                 x = x.reshape((x.shape[0],) + in_shape)
             return x
 
-        def loss_fn(params, batch, rng):
-            logits = module.apply(params, prep(batch["x"]))
-            ce = optax.softmax_cross_entropy_with_integer_labels(
-                logits, batch["y"])
-            w = batch["w"]
-            return (ce * w).sum() / jnp.maximum(w.sum(), 1.0)
+        loss_fn = self._make_loss(module, prep, ymu, ysigma)
 
         trainer = DistributedTrainer(
             loss_fn, optax.adamw(self.learningRate,
@@ -217,7 +229,8 @@ class DeepClassifier(JaxEstimator):
         from mmlspark_tpu.parallel.trainer import DevicePrefetcher
         from mmlspark_tpu.utils.logging import MetricLogger
         from mmlspark_tpu.utils.profiling import trace
-        metric_log = MetricLogger(every=self.logEvery, name="DeepClassifier")
+        metric_log = MetricLogger(every=self.logEvery,
+                                  name=type(self).__name__)
         prefetcher = DevicePrefetcher(host_batches(), trainer.put_batch)
         try:
             with trace():  # captures a jax trace iff profiling.trace_dir set
@@ -242,17 +255,61 @@ class DeepClassifier(JaxEstimator):
 
         params_host = jax.device_get(state["params"])
         from mmlspark_tpu.models.jax_model import _to_plain
-        model = DeepClassifierModel(featuresCol=fcol, labelCol=lcol)
-        model.set_params(architecture=self.architecture,
-                         architectureArgs=resolved_args)
-        model._state = {
+        state_arrays = {
             "params": _to_plain(params_host),
             "mu": mu, "sigma": sigma,
             "standardize": np.asarray(standardize),
-            "n_classes": np.asarray(n_classes),
             "final_loss": np.asarray(float(jax.device_get(last_loss))),
         }
+        return self._build_fitted(fcol, lcol, resolved_args, state_arrays,
+                                  n_out, ymu, ysigma)
+
+
+@register_stage
+class DeepClassifier(_DeepEstimatorBase):
+    """Distributed deep-net classifier over a device mesh (CNTKLearner parity)."""
+
+    def _n_out(self, frame, ymax, ymu, ysigma):
+        return self._num_classes(frame, ymax)
+
+    def _make_loss(self, module, prep, ymu, ysigma):
+        def loss_fn(params, batch, rng):
+            logits = module.apply(params, prep(batch["x"]))
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["y"])
+            w = batch["w"]
+            return (ce * w).sum() / jnp.maximum(w.sum(), 1.0)
+        return loss_fn
+
+    def _build_fitted(self, fcol, lcol, resolved_args, state_arrays, n_out,
+                      ymu, ysigma):
+        model = DeepClassifierModel(featuresCol=fcol, labelCol=lcol)
+        model.set_params(architecture=self.architecture,
+                         architectureArgs=resolved_args)
+        model._state = {**state_arrays, "n_classes": np.asarray(int(n_out))}
         return model
+
+
+
+def _scoring_prep(model):
+    """Shared scoring scaffolding for the fitted deep models: the zoo
+    module, device params (jit ARGUMENTS — closure captures inline into the
+    HLO as constants), and the standardize/reshape preamble."""
+    spec = model._spec()
+    module = spec["module"]
+    in_shape = tuple(spec["input_shape"])
+    params = jax.tree_util.tree_map(jnp.asarray, model._state["params"])
+    standardize = bool(model._state.get("standardize", True))
+    mu = jnp.asarray(model._state["mu"])
+    sigma = jnp.asarray(model._state["sigma"])
+
+    def pre(mu_, sigma_, X):
+        x = (X - mu_) / sigma_ if standardize else X
+        if len(in_shape) > 1:
+            x = x.reshape((x.shape[0],) + in_shape)
+        return x
+
+    return module, params, mu, sigma, pre
 
 
 @register_stage
@@ -272,22 +329,11 @@ class DeepClassifierModel(HasFeaturesCol, HasLabelCol, Model):
         return build_model(self.architecture, **self.get("architectureArgs"))
 
     def scores_fn(self):
-        spec = self._spec()
-        module = spec["module"]
-        in_shape = tuple(spec["input_shape"])
-        # params are jit ARGUMENTS: closure-captured arrays inline into the
-        # HLO as constants and bloat compiles by the full parameter size
-        params = jax.tree_util.tree_map(jnp.asarray, self._state["params"])
-        standardize = bool(self._state.get("standardize", True))
-        mu = jnp.asarray(self._state["mu"])
-        sigma = jnp.asarray(self._state["sigma"])
+        module, params, mu, sigma, pre = _scoring_prep(self)
 
         @jax.jit
         def f(p, mu_, sigma_, X):
-            x = (X - mu_) / sigma_ if standardize else X
-            if len(in_shape) > 1:
-                x = x.reshape((x.shape[0],) + in_shape)
-            logits = module.apply(p, x)
+            logits = module.apply(p, pre(mu_, sigma_, X))
             return logits, jax.nn.softmax(logits, axis=-1)
         return lambda X: f(params, mu, sigma, X)
 
@@ -314,3 +360,74 @@ class DeepClassifierModel(HasFeaturesCol, HasLabelCol, Model):
             jm._state["input_sigma"] = np.asarray(
                 self._state["sigma"], np.float32).reshape(in_shape)
         return jm
+
+
+@register_stage
+class DeepRegressor(_DeepEstimatorBase):
+    """Distributed deep-net regressor over a device mesh (CNTKLearner parity).
+
+    The regression face of the CNTKLearner-parity Estimator (the reference's
+    CNTKLearner trained whatever net the BrainScript described —
+    classification or regression — ``CNTKLearner.scala:52-162``). Drop-in
+    learner for ``TrainRegressor``.
+
+    Targets are z-scored with fit-time statistics (like MLPRegressor) so
+    the loss is well-conditioned regardless of label scale; predictions are
+    un-scaled on the way out.
+    """
+
+    is_classifier = False
+    _y_dtype = np.float32
+
+    def _n_out(self, frame, ymax, ymu, ysigma):
+        return 1
+
+    def _make_loss(self, module, prep, ymu, ysigma):
+        ymu_, ysig_ = float(ymu), float(ysigma)
+
+        def loss_fn(params, batch, rng):
+            pred = module.apply(params, prep(batch["x"]))[:, 0]
+            target = (batch["y"] - ymu_) / ysig_
+            w = batch["w"]
+            se = (pred - target) ** 2
+            return (se * w).sum() / jnp.maximum(w.sum(), 1.0)
+        return loss_fn
+
+    def _build_fitted(self, fcol, lcol, resolved_args, state_arrays, n_out,
+                      ymu, ysigma):
+        model = DeepRegressorModel(featuresCol=fcol, labelCol=lcol)
+        model.set_params(architecture=self.architecture,
+                         architectureArgs=resolved_args)
+        model._state = {**state_arrays, "ymu": np.asarray(float(ymu)),
+                        "ysigma": np.asarray(float(ysigma))}
+        return model
+
+
+@register_stage
+class DeepRegressorModel(HasFeaturesCol, HasLabelCol, Model):
+    """Fitted deep regressor scoring through the jitted zoo architecture.
+
+    Streams minibatches through the net and un-scales z-scored predictions
+    with the fit-time target statistics."""
+
+    architecture = StringParam("architecture", "model zoo architecture", "")
+    architectureArgs = DictParam("architectureArgs", "builder kwargs", {})
+
+    def _spec(self):
+        from mmlspark_tpu.models.zoo import build_model
+        return build_model(self.architecture, **self.get("architectureArgs"))
+
+    def predict_fn(self):
+        module, params, mu, sigma, pre = _scoring_prep(self)
+        ymu = float(self._state["ymu"])
+        ysigma = float(self._state["ysigma"])
+
+        @jax.jit
+        def f(p, mu_, sigma_, X):
+            pred = module.apply(p, pre(mu_, sigma_, X))[:, 0]
+            return pred * ysigma + ymu
+        return lambda X: f(params, mu, sigma, X)
+
+    def transform(self, frame: Frame) -> Frame:
+        from mmlspark_tpu.train.learners import _score_regressor
+        return _score_regressor(self, frame)

@@ -15,7 +15,7 @@ from mmlspark_tpu.core.serialization import load_stage, save_stage
 from mmlspark_tpu.evaluate.compute_model_statistics import ComputeModelStatistics
 from mmlspark_tpu.parallel.mesh import MeshSpec
 from mmlspark_tpu.train.deep import DeepClassifier, DeepClassifierModel
-from mmlspark_tpu.train.train_classifier import TrainClassifier
+from mmlspark_tpu.train.train_classifier import TrainClassifier, TrainRegressor
 
 from tests.test_train import make_census_like
 
@@ -142,3 +142,53 @@ def test_deep_classifier_to_jax_model_feature_extraction():
     logits, _ = model._cached_jit(model.scores_fn)(X)
     np.testing.assert_allclose(logits_from_feats, np.asarray(logits),
                                rtol=1e-4, atol=1e-4)
+
+
+# -- DeepRegressor: the regression face of the CNTKLearner parity ------------
+
+def test_deep_regressor_through_train_regressor():
+    from mmlspark_tpu.train.deep import DeepRegressor
+    rng = np.random.default_rng(7)
+    n = 400
+    hours = rng.uniform(0, 10, n)
+    dist = rng.uniform(100, 2000, n)
+    kind = rng.choice(["a", "b"], n)
+    delay = 3.0 * hours + 0.01 * dist + np.where(kind == "a", 5.0, 0.0) \
+        + rng.normal(0, 0.5, n)
+    from mmlspark_tpu.core.frame import Frame
+    frame = Frame.from_dict({"hours": hours, "dist": dist,
+                             "kind": kind.tolist(), "delay": delay})
+    learner = DeepRegressor(architecture="mlp_tabular",
+                            architectureArgs={"hidden": [32]},
+                            batchSize=64, epochs=60, learningRate=3e-3)
+    model = TrainRegressor(model=learner, labelCol="delay").fit(frame)
+    scored = model.transform(frame)
+    assert find_score_column(scored.schema, ScoreKind.SCORES) == "scores"
+    pred = np.asarray(scored.column("scores"))
+    ss_res = ((pred - delay) ** 2).sum()
+    ss_tot = ((delay - delay.mean()) ** 2).sum()
+    r2 = 1 - ss_res / ss_tot
+    assert r2 > 0.9, f"R^2 {r2}"
+
+
+def test_deep_regressor_save_load_roundtrip(tmp_path):
+    from mmlspark_tpu.core.frame import Frame
+    from mmlspark_tpu.train.deep import DeepRegressor, DeepRegressorModel
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(96, 5)).astype(np.float32)
+    y = (X @ np.arange(1, 6)).astype(np.float64) + 100.0  # shifted scale
+    frame = Frame.from_dict({"features": X, "label": y})
+    learner = DeepRegressor(architecture="mlp_tabular",
+                            architectureArgs={"hidden": [16]},
+                            batchSize=32, epochs=30)
+    learner.set_params(featuresCol="features", labelCol="label")
+    model = learner.fit(frame)
+    p1 = model.transform(frame).column("prediction")
+    assert abs(np.mean(p1) - 100.0) < 10  # un-scaling actually applied
+
+    path = str(tmp_path / "deep_reg")
+    save_stage(model, path)
+    loaded = load_stage(path)
+    assert isinstance(loaded, DeepRegressorModel)
+    np.testing.assert_allclose(loaded.transform(frame).column("prediction"),
+                               p1)

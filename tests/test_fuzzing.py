@@ -107,6 +107,7 @@ def _configs():
     from mmlspark_tpu.evaluate.compute_per_instance_statistics import (
         ComputePerInstanceStatistics)
     from mmlspark_tpu.evaluate.find_best_model import FindBestModel
+    from mmlspark_tpu.train.deep import DeepClassifier, DeepRegressor
     from mmlspark_tpu.feature.featurize import AssembleFeatures, Featurize
     from mmlspark_tpu.feature.multi_column_adapter import MultiColumnAdapter
     from mmlspark_tpu.feature.text import (
@@ -166,16 +167,18 @@ def _configs():
                                           kinds=["double", "float", "int"],
                                           with_label="real")),
         "LogisticRegression": (_lr, _features_frame),
-        "DeepClassifier": (lambda: __import__(
-            "mmlspark_tpu.train.deep", fromlist=["DeepClassifier"])
-            .DeepClassifier(architectureArgs={"hidden": [8]}, batchSize=16,
-                            epochs=2), _features_frame),
+        "DeepClassifier": (lambda: DeepClassifier(
+            architectureArgs={"hidden": [8]}, batchSize=16, epochs=2),
+            _features_frame),
         "MLPClassifier": (lambda: MLPClassifier(maxIter=10, layers=[8]),
                           _features_frame),
         "NaiveBayes": (lambda: NaiveBayes(), _features_frame),
         "LinearRegression": (lambda: LinearRegression(), _reg_features_frame),
         "MLPRegressor": (lambda: MLPRegressor(maxIter=10, layers=[8]),
                          _reg_features_frame),
+        "DeepRegressor": (lambda: DeepRegressor(
+            architectureArgs={"hidden": [8]}, batchSize=16, epochs=2),
+            _reg_features_frame),
         "DecisionTreeClassifier": (lambda: DecisionTreeClassifier(maxDepth=3),
                                    _features_frame),
         "RandomForestClassifier": (lambda: RandomForestClassifier(
@@ -236,6 +239,7 @@ EXCLUDED = {
     "TreeRegressorModel": "model of tree regressors",
     "GBTClassifierModel": "model of GBTClassifier",
     "DeepClassifierModel": "model of DeepClassifier",
+    "DeepRegressorModel": "model of DeepRegressor",
     "TrainedClassifierModel": "model of TrainClassifier",
     "TrainedRegressorModel": "model of TrainRegressor",
     "BestModel": "model of FindBestModel",
