@@ -42,14 +42,18 @@ class PipelineStage(Params):
         if state:
             self._state = state
 
-    def _cached_jit(self, builder):
+    def _cached_jit(self, builder, key: Any = None):
         """Memoize a jitted closure over this stage's state: the first jit
         compile on TPU is 20-40s, so repeat transform() calls must not pay it
-        again. Invalidated by _set_state and copy()."""
-        fn = getattr(self, "_jit_cache", None)
-        if fn is None:
-            fn = builder()
-            self._jit_cache = fn
+        again. Invalidated by _set_state and copy(), and by a changed
+        ``key`` — pass the params the closure bakes in (output node,
+        preprocessing spec, ...) so editing them between transforms can't
+        serve a stale program."""
+        cached = getattr(self, "_jit_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        fn = builder()
+        self._jit_cache = (key, fn)
         return fn
 
 

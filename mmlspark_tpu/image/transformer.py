@@ -93,7 +93,8 @@ class ImageTransformer(HasInputCol, HasOutputCol, Transformer):
 
         def run(p):
             arr = p[self.inputCol]
-            out = np.empty(len(arr), dtype=np.object_)
+            paths: List[Optional[str]] = []
+            datas: List[np.ndarray] = []
             for i, v in enumerate(arr):
                 if in_col.dtype == DType.BINARY:
                     data = decode_image(v)
@@ -101,17 +102,26 @@ class ImageTransformer(HasInputCol, HasOutputCol, Transformer):
                         raise SchemaError(
                             f"undecodable bytes at row {i}; use read_images "
                             "to drop undecodable files instead")
-                    img = ImageValue(path=None, data=data)
+                    paths.append(None)
+                    datas.append(data)
                 elif in_col.dtype == DType.IMAGE:
-                    img = v
+                    paths.append(v.path)
+                    datas.append(v.data)
                 else:
                     raise SchemaError(
                         f"column {self.inputCol!r} is {in_col.dtype.value}, "
                         "need image or binary")
-                data = img.data
-                for s in stages:
-                    data = STAGE_REGISTRY[s["op"]](data, s)
-                out[i] = ImageValue(path=img.path, data=data)
+            # Columnar execution: each stage sweeps the whole partition, so
+            # resize batches every same-shape group through one vectorized
+            # call instead of a per-image Python loop.
+            for s in stages:
+                if s["op"] == "resize":
+                    datas = ops.resize_many(datas, s["height"], s["width"])
+                else:
+                    datas = [STAGE_REGISTRY[s["op"]](d, s) for d in datas]
+            out = np.empty(len(arr), dtype=np.object_)
+            for i, (pth, data) in enumerate(zip(paths, datas)):
+                out[i] = ImageValue(path=pth, data=data)
             return out
 
         return frame.with_column(

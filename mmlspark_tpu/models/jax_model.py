@@ -44,6 +44,13 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
                              validator=lambda v: v > 0)
     outputNodeName = StringParam(
         "outputNodeName", "layer to emit ('' = final output)", "")
+    devicePreprocess = DictParam(
+        "devicePreprocess", "on-device input preprocessing fused into the "
+        "scoring jit: {'srcShape': [h, w, c], 'resize': [H, W]} reshapes "
+        "the flat wire vector to srcShape and bilinear-resizes it to the "
+        "model input ON DEVICE ({} = off). The north-star fusion: raw "
+        "uint8 crosses host->HBM, resize+normalize fuse ahead of the "
+        "first layer instead of running per-image on the host.", {})
 
     def set_model(self, architecture: str, params: Optional[Any] = None,
                   seed: int = 0, **arch_kwargs) -> "JaxModel":
@@ -87,13 +94,30 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         # (e.g. DeepClassifier) carry fit-time statistics so extraction sees
         # the same distribution the net was trained on. Shapes must broadcast
         # against the model input shape.
+        dp = self.get("devicePreprocess")
+        if dp:
+            src = tuple(int(v) for v in dp["srcShape"])
+            dst = tuple(int(v) for v in dp.get("resize") or ())
+
+            from mmlspark_tpu.ops.pallas_preprocess import (
+                device_resize_bilinear,
+            )
+
+            def base(x):
+                x = _to_float(x.reshape((x.shape[0],) + src))
+                if dst and dst != src[:2]:
+                    x = device_resize_bilinear(x, dst[0], dst[1])
+                return x
+        else:
+            base = _to_float
+
         mu = self._state.get("input_mu")
         if mu is not None:
             mu_d = jnp.asarray(mu)
             sigma_d = jnp.asarray(self._state["input_sigma"])
-            pre = lambda x: (_to_float(x) - mu_d) / sigma_d
+            pre = lambda x: (base(x) - mu_d) / sigma_d
         else:
-            pre = _to_float
+            pre = base
 
         if not node:
             jitted = jax.jit(lambda p, x: module.apply(p, pre(x)))
@@ -109,11 +133,14 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         # sown layer; capture_intermediates=True records EVERY submodule
         # output and costs ~3x at runtime, so it is the fallback, not the
         # default.
-        in_shape = tuple(spec["input_shape"])
+        if dp:
+            probe_shape = (1, int(np.prod(src)))
+        else:
+            probe_shape = (1,) + tuple(spec["input_shape"])
         dt = jnp.int32 if spec.get("input_dtype") == "int32" else jnp.float32
         probe = jax.eval_shape(
             lambda x: apply_with_intermediates(module, params, pre(x))[1],
-            jax.ShapeDtypeStruct((1,) + in_shape, dt))
+            jax.ShapeDtypeStruct(probe_shape, dt))
         capture_all = not select(probe)
 
         @jax.jit
@@ -131,11 +158,20 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         """Host-side input coercion (reference UDFs :195-212) + reshape.
         uint8 inputs stay uint8 — they cross host->HBM at 1/4 the bytes and
         cast to float INSIDE the jit (the fused-preprocess fast path)."""
-        in_shape = tuple(spec["input_shape"])
         want_int = spec.get("input_dtype") == "int32"
         arr = np.asarray(arr)
         if arr.dtype != np.uint8 or want_int:
             arr = arr.astype(np.int32 if want_int else np.float32)
+        dp = self.get("devicePreprocess")
+        if dp:
+            # the jit reshapes/resizes on device; ship the flat wire vector
+            want = int(np.prod(dp["srcShape"]))
+            if arr.ndim != 2 or arr.shape[1] != want:
+                raise SchemaError(
+                    f"devicePreprocess srcShape {dp['srcShape']} wants flat "
+                    f"width {want}, got {arr.shape}")
+            return arr
+        in_shape = tuple(spec["input_shape"])
         if arr.ndim == 2 and len(in_shape) > 1:
             if int(np.prod(in_shape)) != arr.shape[1]:
                 raise SchemaError(
@@ -145,7 +181,11 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
 
     def transform(self, frame: Frame) -> Frame:
         spec = self._spec()
-        apply, _ = self._cached_jit(lambda: self._build_apply())
+        apply, _ = self._cached_jit(
+            lambda: self._build_apply(),
+            key=(self.architecture, repr(self.get("architectureArgs")),
+                 self.outputNodeName, repr(self.get("devicePreprocess")),
+                 ))
         bs = self.miniBatchSize
         # Async scoring loop: a batch's transfer + forward is DISPATCHED
         # before earlier results are fetched (JAX dispatch returns

@@ -97,3 +97,34 @@ def make_preprocess_fn(image_shape: Tuple[int, int, int],
         return fused_normalize(u8_flat, mean_vec, inv_std_vec,
                                (h, w, c), out_dtype)
     return preprocess
+
+
+def device_resize_bilinear(x: jax.Array, height: int, width: int) -> jax.Array:
+    """On-device bilinear resize of (B, H, W, C) float images, half-pixel
+    centers with edge clamp — the SAME convention as the host path
+    (``image/ops.py _resize_stack``), so fusing the resize into a scoring
+    jit is a pure acceleration, not a semantic change. (``jax.image.resize``
+    would anti-alias on downscale and diverge from the OpenCV-style host
+    numbers.) Gather indices/weights are compile-time constants; the lerp is
+    two taken-row blends per axis, fused by XLA."""
+    b, h, w = x.shape[:3]
+    if (h, w) == (height, width):
+        return x
+
+    def plan(src, dst):
+        s = (np.arange(dst) + 0.5) * src / dst - 0.5
+        i0 = np.clip(np.floor(s).astype(np.int64), 0, src - 1)
+        i1 = np.clip(i0 + 1, 0, src - 1)
+        frac = np.clip(s - i0, 0.0, 1.0).astype(np.float32)
+        return jnp.asarray(i0), jnp.asarray(i1), jnp.asarray(frac)
+
+    y0, y1, wy = plan(h, height)
+    x0, x1, wx = plan(w, width)
+    wy = wy[None, :, None, None]
+    wx = wx[None, None, :, None]
+    r0 = jnp.take(x, y0, axis=1)
+    r1 = jnp.take(x, y1, axis=1)
+    rows = r0 * (1 - wy) + r1 * wy
+    c0 = jnp.take(rows, x0, axis=2)
+    c1 = jnp.take(rows, x1, axis=2)
+    return c0 * (1 - wx) + c1 * wx

@@ -29,7 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mmlspark_tpu.parallel.mesh import mesh_from_config
 from mmlspark_tpu.parallel.sharding import (
-    batch_sharding, param_shardings, Rules, shard_batch,
+    active_batch_axes, batch_sharding, param_shardings, Rules, shard_batch,
 )
 from mmlspark_tpu.utils import config as mmlconfig
 from mmlspark_tpu.utils.logging import MetricLogger
@@ -124,6 +124,109 @@ class DevicePrefetcher:
                 raise self._err
             raise StopIteration
         return self._put(item)
+
+
+class DeviceEpochCache:
+    """Device-resident epoch: one host->HBM transfer, batches sliced on device.
+
+    Streaming a host batch per step is the CNTKModel anti-pattern's last
+    residue — on links where host->HBM transfers contend with execution
+    (PCIe under load, tunneled chips), every per-step ``device_put`` stalls
+    the pipeline. When the (featurized) epoch fits in an HBM budget, the
+    TPU-first move is residency: transfer once, then every batch is an XLA
+    slice of an already-on-device array — zero steady-state transfer.
+
+    Layout: each column is reshaped host-side to ``(steps, batch, ...)`` and
+    placed with the BATCH dim (axis 1) sharded over the mesh's data axes, so
+    slicing out batch ``i`` along the replicated axis 0 moves no data across
+    devices and yields exactly the sharding ``put_batch`` would have
+    committed. Optional per-epoch shuffling permutes rows on device with a
+    ``fold_in(seed, epoch)`` key — deterministic, so elastic resume replays
+    the same order (the contract DeepClassifier's streaming path keeps).
+
+    Rows beyond ``steps * batch_size`` are dropped; callers that need the
+    tail pad-and-mask FIRST (``_pad_xyw``) and let the pad rows ride along
+    with zero weight.
+    """
+
+    def __init__(self, data: Dict[str, np.ndarray], batch_size: int,
+                 mesh: Optional[Mesh] = None, seq_axis: Optional[str] = None,
+                 shuffle: bool = False, seed: int = 0):
+        self.mesh = mesh or mesh_from_config()
+        self.batch_size = int(batch_size)
+        first = next(iter(data.values()))
+        n = first.shape[0]
+        self.steps_per_epoch = n // self.batch_size
+        if self.steps_per_epoch < 1:
+            raise ValueError(
+                f"epoch of {n} rows is smaller than batch_size {batch_size}")
+        self.shuffle = shuffle
+        self.seed = seed
+        self._epoch: Optional[int] = None
+
+        keep = self.steps_per_epoch * self.batch_size
+        with self.mesh:
+            def put(name, x):
+                x = np.ascontiguousarray(
+                    np.asarray(x)[:keep].reshape(
+                        (self.steps_per_epoch, self.batch_size)
+                        + np.asarray(x).shape[1:]))
+                axes = active_batch_axes(self.mesh)
+                if (seq_axis and x.ndim > 2
+                        and self.mesh.shape.get(seq_axis, 1) > 1):
+                    spec = P(None, axes, seq_axis)
+                else:
+                    spec = P(None, axes)
+                return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+            self._data = {k: put(k, v) for k, v in data.items()}
+            self._base = self._data  # unshuffled epoch tensor (perm source)
+            self._index = jax.jit(
+                lambda d, i: jax.tree_util.tree_map(lambda a: a[i], d))
+            if shuffle:
+                def permute(d, key):
+                    m = self.steps_per_epoch * self.batch_size
+                    perm = jax.random.permutation(key, m)
+                    def one(a):
+                        flat = a.reshape((m,) + a.shape[2:])
+                        return jnp.take(flat, perm, axis=0).reshape(a.shape)
+                    return jax.tree_util.tree_map(one, d)
+                self._permute = jax.jit(
+                    permute,
+                    out_shardings=jax.tree_util.tree_map(
+                        lambda a: a.sharding, self._data))
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(a.nbytes) for a in self._data.values())
+
+    @staticmethod
+    def fits(data: Dict[str, np.ndarray],
+             budget_mb: Optional[float] = None,
+             shuffle: bool = False) -> bool:
+        """Would this host epoch fit the ``runtime.device_cache_mb`` budget?
+        ``data`` may hold real arrays OR shape/dtype-only stand-ins (e.g.
+        ``np.broadcast_to`` views), so callers can budget-check WITHOUT
+        materializing the epoch. ``shuffle=True`` doubles the requirement:
+        the cache keeps the unshuffled base AND the current permutation
+        resident."""
+        if budget_mb is None:
+            budget_mb = float(mmlconfig.get("runtime.device_cache_mb"))
+        total = sum(np.asarray(v).nbytes for v in data.values())
+        return total * (2 if shuffle else 1) <= budget_mb * 1e6
+
+    def batches(self, epoch: int = 0):
+        """Device batch dicts for one epoch (shuffled iff ``shuffle``)."""
+        if self.shuffle:
+            if self._epoch != epoch:
+                with self.mesh:
+                    self._data = self._permute(
+                        self._base, jax.random.fold_in(
+                            jax.random.PRNGKey(self.seed), epoch))
+                self._epoch = epoch
+        for i in range(self.steps_per_epoch):
+            with self.mesh:
+                yield self._index(self._data, i)
 
 
 class DistributedTrainer:

@@ -130,6 +130,11 @@ class _DeepEstimatorBase(JaxEstimator):
     checkpointEvery = IntParam(
         "checkpointEvery", "save every N steps when checkpointDir is set", 100)
     logEvery = IntParam("logEvery", "log train metrics every N steps (0=off)", 0)
+    deviceCache = StringParam(
+        "deviceCache", "keep the padded epoch resident in HBM and slice "
+        "batches on device: 'auto' (when it fits runtime.device_cache_mb), "
+        "'on', 'off' (stream host batches)", "auto",
+        domain=("auto", "on", "off"))
 
     # -- data streaming ----------------------------------------------------
     # Stats and padding come from JaxEstimator._streaming_stats / _pad_xyw
@@ -142,6 +147,37 @@ class _DeepEstimatorBase(JaxEstimator):
         from mmlspark_tpu.train.learners import _pad_xyw
         x, y, w = _pad_xyw(hb, fcol, lcol, bs, cls._y_dtype)
         return {"x": x, "y": y, "w": w}
+
+    def _make_device_cache(self, frame: Frame, fcol: str, lcol: str,
+                           bs: int, mesh, n: int, d: int):
+        """DeviceEpochCache over the pad-and-masked epoch, or None.
+
+        'auto' caches when the padded epoch fits ``runtime.device_cache_mb``
+        (x2 for the shuffle copy); 'on' forces it; 'off' streams. The budget
+        check runs on shape/dtype stand-ins so an over-budget frame costs no
+        host materialization. The tail rows are padded ONCE with zero weight
+        and ride along through every shuffled epoch — masked out of the loss
+        wherever the permutation lands them.
+        """
+        mode = self.get("deviceCache")
+        if mode == "off":
+            return None
+        from mmlspark_tpu.parallel.trainer import DeviceEpochCache
+        from mmlspark_tpu.train.learners import _pad_xyw
+        padded = int(math.ceil(n / bs) * bs)
+        stand_in = {
+            "x": np.broadcast_to(np.float32(0), (padded, d)),
+            "y": np.broadcast_to(np.zeros((), self._y_dtype), (padded,)),
+            "w": np.broadcast_to(np.float32(0), (padded,))}
+        if mode == "auto" and not DeviceEpochCache.fits(stand_in,
+                                                       shuffle=True):
+            return None
+        x = np.asarray(frame.column(fcol), dtype=np.float32)
+        y = np.asarray(frame.column(lcol))
+        xp, yp, wp = _pad_xyw({fcol: x, lcol: y}, fcol, lcol, padded,
+                              self._y_dtype)
+        return DeviceEpochCache({"x": xp, "y": yp, "w": wp}, bs, mesh=mesh,
+                                shuffle=True, seed=self.seed)
 
     # -- task hooks (subclass responsibility) -------------------------------
     def _n_out(self, frame: Frame, ymax, ymu, ysigma) -> int:
@@ -214,6 +250,10 @@ class _DeepEstimatorBase(JaxEstimator):
         rng = jax.random.PRNGKey(seed)
         step, last_loss = done, None
 
+        # a fully-resumed fit runs zero steps — don't pay the epoch transfer
+        cache = (self._make_device_cache(frame, fcol, lcol, bs, mesh, n, d)
+                 if done < total_steps else None)
+
         def host_batches():
             """Padded fixed-shape batches, shuffled per epoch. The epoch's
             permutation is seeded by (seed, epoch) so an elastic resume
@@ -226,12 +266,25 @@ class _DeepEstimatorBase(JaxEstimator):
                         continue
                     yield self._pad_batch(hb, fcol, lcol, bs)
 
+        def cached_batches():
+            """Same epoch/skip arithmetic as host_batches, but every batch
+            is an on-device slice of the resident epoch — zero steady-state
+            host->HBM transfer. The device-side shuffle is seeded per epoch,
+            so resume replays the same order WITHIN this mode (the two modes
+            draw different permutations; each is deterministic)."""
+            for epoch in range(start_epoch, self.epochs):
+                for j, b in enumerate(cache.batches(epoch)):
+                    if epoch == start_epoch and j < skip_in_epoch:
+                        continue
+                    yield b
+
         from mmlspark_tpu.parallel.trainer import DevicePrefetcher
         from mmlspark_tpu.utils.logging import MetricLogger
         from mmlspark_tpu.utils.profiling import trace
         metric_log = MetricLogger(every=self.logEvery,
                                   name=type(self).__name__)
-        prefetcher = DevicePrefetcher(host_batches(), trainer.put_batch)
+        prefetcher = (cached_batches() if cache is not None else
+                      DevicePrefetcher(host_batches(), trainer.put_batch))
         try:
             with trace():  # captures a jax trace iff profiling.trace_dir set
                 for batch in prefetcher:
@@ -243,7 +296,8 @@ class _DeepEstimatorBase(JaxEstimator):
                         ckpt.maybe_save(state, every=self.checkpointEvery,
                                         step=step)
         finally:
-            prefetcher.close()  # stops the producer on early exit
+            if isinstance(prefetcher, DevicePrefetcher):
+                prefetcher.close()  # stops the producer on early exit
         if ckpt is not None:
             ckpt.save(state, step=step, wait=True)
         if last_loss is None:

@@ -162,10 +162,16 @@ def _stream_adam(loss_fn: Callable, params: Any, frame: Frame,
     Each epoch streams a FRESH global row permutation, so ordered data
     (label- or time-sorted) never biases a step and every row participates
     as long as ``max_steps`` covers an epoch. ``loss_fn(params, x, y, w)``
-    must be a per-row-weighted loss. When the whole frame fits in a single
-    batch the padded device batch is kept resident across steps (no
-    host->HBM churn), which makes the small-data case equivalent to the old
-    full-batch loop.
+    must be a per-row-weighted loss.
+
+    Epoch residency: when the pad-and-masked epoch fits the
+    ``runtime.device_cache_mb`` HBM budget (the common case for tabular
+    learners), it is placed on device ONCE and every batch is an XLA slice
+    of the resident array with a device-side per-epoch shuffle — zero
+    steady-state host->HBM transfer. Larger-than-budget frames fall back to
+    streaming shuffled host batches. Learners are single-device by design
+    (the data-parallel path is DeepClassifier); the cache mesh is pinned to
+    one device so the plain-jit step sees uncommitted-compatible inputs.
     """
     opt = optax.adam(lr)
     opt_state = opt.init(params)
@@ -176,30 +182,59 @@ def _stream_adam(loss_fn: Callable, params: Any, frame: Frame,
         updates, s = opt.update(g, s, p)
         return optax.apply_updates(p, updates), s, loss
 
-    host_rng = np.random.default_rng(seed)
+    from mmlspark_tpu.parallel.trainer import DeviceEpochCache
+    n = frame.count()
+    if n == 0:
+        raise ValueError("empty frame")
+    d = np.asarray(frame.head(1)[0][fcol]).size
+    padded = int(np.ceil(n / batch_size) * batch_size)
+    # budget-check on shape/dtype stand-ins — the epoch is only
+    # materialized when it will actually be cached
+    stand_in = {
+        "x": np.broadcast_to(np.float32(0), (padded, d)),
+        "y": np.broadcast_to(np.zeros((), y_dtype), (padded,)),
+        "w": np.broadcast_to(np.float32(0), (padded,))}
     steps = 0
-    resident = None  # device batch reused when the frame is one batch wide
+    if DeviceEpochCache.fits(stand_in, shuffle=padded > batch_size):
+        x_all = np.asarray(frame.column(fcol), np.float32)
+        y_all = np.asarray(frame.column(lcol))
+        epoch = dict(zip(("x", "y", "w"),
+                         _pad_xyw({fcol: x_all, lcol: y_all}, fcol, lcol,
+                                  padded, y_dtype)))
+        from jax.sharding import Mesh
+        one_dev = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        # a single-batch epoch needs no shuffle: batch composition is
+        # invariant under permutation and the per-epoch gather isn't free
+        cache = DeviceEpochCache(epoch, batch_size, mesh=one_dev,
+                                 shuffle=padded > batch_size, seed=seed)
+        # commit state to the cache's mesh up front: otherwise step 1 runs
+        # with uncommitted params, step 2 with committed outputs — two
+        # compiles of the same step
+        from jax.sharding import NamedSharding, PartitionSpec
+        rep = NamedSharding(one_dev, PartitionSpec())
+        params = jax.device_put(params, rep)
+        opt_state = jax.device_put(opt_state, rep)
+        epoch_i = 0
+        while steps < max_steps:
+            for b in cache.batches(epoch_i):
+                params, opt_state, _ = step(params, opt_state,
+                                            b["x"], b["y"], b["w"])
+                steps += 1
+                if steps >= max_steps:
+                    break
+            epoch_i += 1
+        return params
+
+    host_rng = np.random.default_rng(seed)
     while steps < max_steps:
-        if resident is not None:
-            params, opt_state, _ = step(params, opt_state, *resident)
-            steps += 1
-            continue
-        n_batches, first = 0, None
         for hb in frame.shuffled_batches(batch_size, cols=[fcol, lcol],
                                          rng=host_rng):
             dev = tuple(jax.device_put(a)
                         for a in _pad_xyw(hb, fcol, lcol, batch_size, y_dtype))
-            n_batches += 1
-            if n_batches == 1:
-                first = dev
             params, opt_state, _ = step(params, opt_state, *dev)
             steps += 1
             if steps >= max_steps:
                 break
-        if n_batches == 0:
-            raise ValueError("empty frame")
-        if n_batches == 1:
-            resident = first
     return params
 
 

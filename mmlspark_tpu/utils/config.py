@@ -22,6 +22,7 @@ _DEFAULTS: Dict[str, Any] = {
     "runtime.prefetch_depth": 2,      # host->device prefetch queue depth
     "runtime.decode_threads": 0,      # 0 = native codec picks (ncpu)
     "runtime.mesh": "",               # launcher default, e.g. "data=-1,tensor=2"
+    "runtime.device_cache_mb": 1024,  # HBM budget for device-resident epochs
     # logging
     "logging.level": "INFO",
     "logging.metrics_every": 0,       # default train-metric log cadence (steps)

@@ -200,6 +200,33 @@ def test_image_featurizer_features_and_logits(rng):
             "vit_tiny", num_classes=9, image_size=8, patch=4).transform(f)
 
 
+def test_image_featurizer_fused_device_resize_matches_host(rng):
+    """Uniform uint8 images take the fused path (uint8 wire + on-device
+    resize inside the scoring jit); its features must match the host
+    resize->unroll->score path closely."""
+    f = make_image_frame(rng, n=4, h=20, w=30)  # uniform uint8 -> fused
+    feat = ImageFeaturizer(cutOutputLayers=1, miniBatchSize=4)
+    feat.set_model("vit_tiny", num_classes=9, image_size=8, patch=4)
+    fused = feat.transform(f)
+    assert feat._jm_cache.get("devicePreprocess") == {
+        "srcShape": [20, 30, 3], "resize": [8, 8]}
+
+    # force the host path by making the data float32 (same pixel values)
+    from mmlspark_tpu.core.schema import ColumnSchema, DType, ImageValue
+    vals = [v for p in f.partitions for v in p["image"]]
+    as_f32 = np.empty(len(vals), dtype=object)
+    for i, v in enumerate(vals):
+        as_f32[i] = ImageValue(path=v.path, data=v.data.astype(np.float32))
+    f2 = Frame.from_dict({"row": np.arange(len(vals))})
+    f2 = f2.with_column_values(ColumnSchema("image", DType.IMAGE), as_f32)
+    host = feat.transform(f2)
+    assert feat._jm_cache.get("devicePreprocess") == {}
+    # same interpolation convention (half-pixel bilinear) on both sides;
+    # uint8 rounding on the host path bounds the divergence
+    np.testing.assert_allclose(fused.column("features"),
+                               host.column("features"), atol=0.15)
+
+
 def test_image_featurizer_save_load(rng, tmp_path):
     f = make_image_frame(rng, n=2, h=10, w=10)
     feat = ImageFeaturizer(cutOutputLayers=1, miniBatchSize=2)

@@ -54,6 +54,10 @@ def test_two_process_distributed_psum(tmp_path):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    # the worker script lives in tmp_path, so sys.path won't include the
+    # repo root unless we say so (the package may not be pip-installed)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     procs = [subprocess.Popen(
         [sys.executable, str(worker), str(i), port],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
