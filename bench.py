@@ -248,7 +248,8 @@ def config_eval() -> dict:
         return outs
 
     run_base()
-    t_fw, t_base = _best_pair(lambda: jm.transform(frame), run_base)
+    t_fw, t_base = _best_pair(lambda: jm.transform(frame), run_base,
+                              trials=6)
     fw_ips, base_ips = n / t_fw, n / t_base
     return {"value": round(fw_ips, 2), "unit": "images/sec/chip",
             "vs_baseline": round(fw_ips / base_ips, 4)}
@@ -295,7 +296,8 @@ def config_image_featurize() -> dict:
             jax.device_get(apply(jnp.asarray(pre[off:off + bs])))
 
     run_base()
-    t_fw, t_base = _best_pair(lambda: fz.transform(frame), run_base)
+    t_fw, t_base = _best_pair(lambda: fz.transform(frame), run_base,
+                              trials=6)
     fw_ips, base_ips = n / t_fw, n / t_base
     return {"value": round(fw_ips, 2), "unit": "images/sec/chip",
             "vs_baseline": round(fw_ips / base_ips, 4)}
@@ -320,15 +322,15 @@ def _make_reviews(n: int, seed: int = 3):
 
 def _tokenize_hash(texts) -> np.ndarray:
     """TextFeaturizer's hot path: regex tokenize + Spark-parity murmur3 ->
-    fixed-length id sequences (0 = pad). Natural text repeats its
-    vocabulary, so hash unique terms once and scatter via inverse map."""
+    fixed-length id sequences (0 = pad), through the library's cached batch
+    hasher (repeated vocabulary resolves at dict-lookup speed; cold terms
+    hash through the vectorized kernel)."""
     import re
-    from mmlspark_tpu.ops.hashing import murmur3_batch
+    from mmlspark_tpu.ops.hashing import hash_terms
     tok = re.compile(r"\w+")
     rows = [tok.findall(t.lower()) for t in texts]
-    flat = np.array([w for r in rows for w in r], dtype=object)
-    uniq, inverse = np.unique(flat, return_inverse=True)
-    ids = (murmur3_batch(list(uniq)) % (_VOCAB - 1) + 1)[inverse]
+    flat = [w for r in rows for w in r]
+    ids = hash_terms(flat, _VOCAB - 1).astype(np.int32) + 1
     out = np.zeros((len(rows), _SEQ_LEN), np.int32)
     off = 0
     for i, r in enumerate(rows):
@@ -358,11 +360,14 @@ def _textcnn_trainer():
 
 
 def config_text() -> dict:
-    """Featurize+train, both sides TIMED end to end. The framework streams
-    per-batch featurization through DevicePrefetcher so host tokenize/hash
-    overlaps device steps; the baseline is the reference's two-phase shape
-    (featurize the whole dataset, then train — ``CNTKLearner.fit`` writes
-    the featurized set out before the ``cntk`` process starts)."""
+    """Featurize+train, both sides TIMED end to end — ONE epoch, so data
+    residency has nothing to amortize (DeviceEpochCache is the multi-epoch
+    story; DeepClassifier uses it). The framework's one-pass advantage is
+    OVERLAP: per-batch featurization runs in the DevicePrefetcher producer
+    thread while the device steps on the previous batch. The baseline is
+    the reference's two-phase shape (featurize the whole dataset, then
+    train — ``CNTKLearner.fit`` writes the featurized set out before the
+    ``cntk`` process starts)."""
     import jax
     import jax.numpy as jnp
 
@@ -477,7 +482,7 @@ def config_vit_preprocess() -> dict:
         jax.block_until_ready(out)
 
     run_unfused()
-    t_fw, t_base = _best_pair(run_fused, run_unfused)
+    t_fw, t_base = _best_pair(run_fused, run_unfused, trials=6)
     fw_ips = steps * bs / t_fw
     base_ips = steps * bs / t_base
     return {"value": round(fw_ips, 2), "unit": "images/sec/chip",

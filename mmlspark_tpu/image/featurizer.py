@@ -78,17 +78,20 @@ class ImageFeaturizer(HasInputCol, HasOutputCol, Transformer):
         # uint8 HWC images, skip the host resize entirely. Raw uint8 crosses
         # host->HBM (1/4 the bytes of fp32) and reshape+bilinear-resize run
         # ON DEVICE fused into the scoring jit, ahead of the first conv.
-        shapes = ({v.data.shape for p in frame.partitions
-                   for v in p[self.inputCol]}
-                  if in_dtype == DType.IMAGE else set())
-        dtypes = {v.data.dtype for p in frame.partitions
-                  for v in p[self.inputCol]} if shapes else set()
-        fused = (len(shapes) == 1 and dtypes == {np.dtype(np.uint8)}
-                 and len(next(iter(shapes))) == 3
-                 and next(iter(shapes))[2] == in_shape[2])
+        # One pass collects (shape, dtype); the result also answers the
+        # general path's wire-format question (binary input decodes to
+        # uint8, so only float IMAGE values force the float32 unroll).
+        variants = ({(v.data.shape, v.data.dtype) for p in frame.partitions
+                     for v in p[self.inputCol]}
+                    if in_dtype == DType.IMAGE else set())
+        all_u8 = (in_dtype != DType.IMAGE
+                  or all(dt == np.dtype(np.uint8) for _, dt in variants))
+        fused = (len(variants) == 1 and all_u8
+                 and len(next(iter(variants))[0]) == 3
+                 and next(iter(variants))[0][2] == in_shape[2])
         device_pre = {}
         if fused:
-            src_shape = next(iter(shapes))
+            src_shape = next(iter(variants))[0]
             unrolled = UnrollImage(inputCol=self.inputCol, outputCol=tmp_vec,
                                    outputDtype="uint8").transform(frame)
             device_pre = {"srcShape": [int(v) for v in src_shape],
@@ -103,8 +106,6 @@ class ImageFeaturizer(HasInputCol, HasOutputCol, Transformer):
             # uint8 wire format when the data allows it: 4x less host->HBM
             # traffic; JaxModel casts to float on device. Float image data
             # (user-built ImageValue) keeps the lossless float32 unroll.
-            all_u8 = all(v.data.dtype == np.uint8
-                         for p in resized.partitions for v in p[tmp_img])
             unrolled = UnrollImage(
                 inputCol=tmp_img, outputCol=tmp_vec,
                 outputDtype="uint8" if all_u8 else "float32") \

@@ -191,15 +191,25 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         # before earlier results are fetched (JAX dispatch returns
         # immediately), so host->device DMA overlaps compute instead of the
         # reference's strictly serial fill/evaluate/copy-back minibatch
-        # loop (CNTKModel.scala:50-104). Outputs retire in bounded windows:
-        # one device-side concat + ONE transfer per window — a round trip
-        # per window instead of per batch, without accumulating the whole
-        # output (which for intermediate-layer extraction is NOT small) or
-        # building a concat whose operand count scales with the dataset.
+        # loop (CNTKModel.scala:50-104).
+        #
+        # Transfers are BATCHED: ``put_window`` minibatches stack into ONE
+        # host->HBM put, then each batch is a device-side slice. A transfer
+        # issued while executes are in flight drains the pipeline (tens of
+        # ms on PCIe-contended or tunneled links), so fewer, larger puts
+        # keep the device fed — the scoring-side face of DeviceEpochCache.
+        #
+        # Outputs retire in bounded windows: one device-side concat + ONE
+        # transfer per window — a round trip per window instead of per
+        # batch, without accumulating the whole output (which for
+        # intermediate-layer extraction is NOT small) or building a concat
+        # whose operand count scales with the dataset.
+        put_window = 8         # minibatches per host->device transfer
         window = 32            # output batches fetched per round trip
         in_flight = 8          # bound dispatched-but-unexecuted inputs (HBM)
         dev_outs: list = []
         outs: list = []
+        pending: list = []     # coerced host batches awaiting one put
 
         def retire():
             if not dev_outs:
@@ -209,17 +219,28 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
             outs.append(np.asarray(jax.device_get(stacked)))
             dev_outs.clear()
 
+        def flush():
+            if not pending:
+                return
+            dev = jnp.asarray(np.stack([x for x, _ in pending]))
+            for i, (_, n) in enumerate(pending):
+                dev_outs.append(apply(dev[i])[:n])
+                if len(dev_outs) >= window:
+                    retire()
+                elif len(dev_outs) >= in_flight:
+                    dev_outs[-in_flight].block_until_ready()
+            pending.clear()
+
         for batch in frame.batches(bs, cols=[self.inputCol]):
             x = self._coerce_batch(batch[self.inputCol], spec)
             n = x.shape[0]
             if n < bs:  # pad final batch: keep ONE compiled shape
                 pad = np.zeros((bs - n,) + x.shape[1:], x.dtype)
                 x = np.concatenate([x, pad], axis=0)
-            dev_outs.append(apply(jnp.asarray(x))[:n])
-            if len(dev_outs) >= window:
-                retire()
-            elif len(dev_outs) >= in_flight:
-                dev_outs[-in_flight].block_until_ready()
+            pending.append((x, n))
+            if len(pending) >= put_window:
+                flush()
+        flush()
         retire()
         out = np.concatenate(outs, axis=0) if outs \
             else np.zeros((0, 1), np.float32)

@@ -179,11 +179,16 @@ class DeviceEpochCache:
                     spec = P(None, axes)
                 return jax.device_put(x, NamedSharding(self.mesh, spec))
 
-            self._data = {k: put(k, v) for k, v in data.items()}
-            self._base = self._data  # unshuffled epoch tensor (perm source)
+            base = {k: put(k, v) for k, v in data.items()}
+            self._nbytes = sum(int(a.nbytes) for a in base.values())
+            # Only ever called from _materialize, i.e. while the device is
+            # idle — the per-call scalar transfer for the Python index is
+            # harmless there (steady-state consumption touches no jit).
             self._index = jax.jit(
                 lambda d, i: jax.tree_util.tree_map(lambda a: a[i], d))
             if shuffle:
+                self._base = base
+                self._batches = None  # built per epoch in batches()
                 def permute(d, key):
                     m = self.steps_per_epoch * self.batch_size
                     perm = jax.random.permutation(key, m)
@@ -194,11 +199,15 @@ class DeviceEpochCache:
                 self._permute = jax.jit(
                     permute,
                     out_shardings=jax.tree_util.tree_map(
-                        lambda a: a.sharding, self._data))
+                        lambda a: a.sharding, base))
+            else:
+                # materialize once; the epoch tensor itself is then free
+                self._base = None
+                self._batches = self._materialize(base)
 
     @property
     def nbytes(self) -> int:
-        return sum(int(a.nbytes) for a in self._data.values())
+        return self._nbytes
 
     @staticmethod
     def fits(data: Dict[str, np.ndarray],
@@ -207,26 +216,41 @@ class DeviceEpochCache:
         """Would this host epoch fit the ``runtime.device_cache_mb`` budget?
         ``data`` may hold real arrays OR shape/dtype-only stand-ins (e.g.
         ``np.broadcast_to`` views), so callers can budget-check WITHOUT
-        materializing the epoch. ``shuffle=True`` doubles the requirement:
-        the cache keeps the unshuffled base AND the current permutation
-        resident."""
+        materializing the epoch. ``shuffle=True`` charges 3x: base + the
+        transient permuted tensor + the materialized batch slices are all
+        simultaneously resident at the peak of each epoch's shuffle.
+        Unshuffled charges 2x for the build-time peak (epoch tensor + its
+        slices; the tensor frees after)."""
         if budget_mb is None:
             budget_mb = float(mmlconfig.get("runtime.device_cache_mb"))
         total = sum(np.asarray(v).nbytes for v in data.values())
-        return total * (2 if shuffle else 1) <= budget_mb * 1e6
+        return total * (3 if shuffle else 2) <= budget_mb * 1e6
+
+    def _materialize(self, tensor_dict):
+        """Slice the (steps, batch, ...) epoch into per-batch arrays and
+        BLOCK until they exist. All slicing happens while the device is
+        otherwise idle, so the consumer's steady-state loop dispatches
+        nothing but its own step programs — no mid-stream transfers, and no
+        second program stream interleaving with the step's collectives
+        (concurrent multi-device programs can deadlock a collective
+        rendezvous in the CPU runtime)."""
+        with self.mesh:
+            batches = [self._index(tensor_dict, i)
+                       for i in range(self.steps_per_epoch)]
+            jax.block_until_ready(batches)
+        return batches
 
     def batches(self, epoch: int = 0):
         """Device batch dicts for one epoch (shuffled iff ``shuffle``)."""
-        if self.shuffle:
-            if self._epoch != epoch:
-                with self.mesh:
-                    self._data = self._permute(
-                        self._base, jax.random.fold_in(
-                            jax.random.PRNGKey(self.seed), epoch))
-                self._epoch = epoch
-        for i in range(self.steps_per_epoch):
+        if self.shuffle and self._epoch != epoch:
             with self.mesh:
-                yield self._index(self._data, i)
+                permuted = self._permute(
+                    self._base, jax.random.fold_in(
+                        jax.random.PRNGKey(self.seed), epoch))
+            # permuted frees after slicing; steady state = base + batches
+            self._batches = self._materialize(permuted)
+            self._epoch = epoch
+        yield from self._batches
 
 
 class DistributedTrainer:
@@ -235,6 +259,8 @@ class DistributedTrainer:
     loss_fn(params, batch, rng) -> scalar loss (fp32). The whole step —
     forward, backward, allreduce, optimizer — compiles to one XLA program.
     """
+
+    _THROTTLE = 16  # max un-retired async step programs (see train_step)
 
     def __init__(self, loss_fn: LossFn, optimizer: optax.GradientTransformation,
                  mesh: Optional[Mesh] = None, rules: Optional[Rules] = None,
@@ -252,6 +278,13 @@ class DistributedTrainer:
         self._state_shardings = None
         self._train_step = None
         self._eval_step = None
+        # Dispatch-depth throttle (see train_step): ONLY the multi-device
+        # CPU runtime needs it — its collective rendezvous can starve under
+        # hundreds of queued async steps. Real TPU runtimes bound their own
+        # launch queue, and the readiness probe would cost a host round
+        # trip per step on remote chips.
+        self._inflight: list = []
+        self._throttled = jax.default_backend() == "cpu"
 
     # -- state -------------------------------------------------------------
     def _full_init_fn(self, init_params_fn: Callable[[], Any]):
@@ -343,7 +376,18 @@ class DistributedTrainer:
                 raise RuntimeError("call init() before train_step()")
             self._train_step = self._build_train_step()
         with self.mesh:
-            return self._train_step(state, batch, rng)
+            out = self._train_step(state, batch, rng)
+        # Bound async dispatch depth: when nothing between steps touches the
+        # host (DeviceEpochCache consumers), hundreds of un-retired step
+        # programs can queue up and starve a collective rendezvous in the
+        # multi-device CPU runtime (7-of-8 threads arrive, the runtime
+        # aborts). Waiting on the loss from _THROTTLE steps back is free in
+        # steady state — it has long since computed — and caps the queue.
+        if self._throttled:
+            self._inflight.append(out[1]["loss"])
+            if len(self._inflight) > self._THROTTLE:
+                jax.block_until_ready(self._inflight.pop(0))
+        return out
 
     def eval_step(self, state, batch, rng) -> jax.Array:
         if self._state_shardings is None:

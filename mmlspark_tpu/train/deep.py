@@ -149,35 +149,20 @@ class _DeepEstimatorBase(JaxEstimator):
         return {"x": x, "y": y, "w": w}
 
     def _make_device_cache(self, frame: Frame, fcol: str, lcol: str,
-                           bs: int, mesh, n: int, d: int):
+                           bs: int, mesh):
         """DeviceEpochCache over the pad-and-masked epoch, or None.
 
         'auto' caches when the padded epoch fits ``runtime.device_cache_mb``
-        (x2 for the shuffle copy); 'on' forces it; 'off' streams. The budget
-        check runs on shape/dtype stand-ins so an over-budget frame costs no
-        host materialization. The tail rows are padded ONCE with zero weight
-        and ride along through every shuffled epoch — masked out of the loss
-        wherever the permutation lands them.
-        """
+        (see ``DeviceEpochCache.fits`` for the peak-residency accounting);
+        'on' forces it; 'off' streams. Construction is shared with the
+        built-in learners (``learners._epoch_device_cache``)."""
         mode = self.get("deviceCache")
         if mode == "off":
             return None
-        from mmlspark_tpu.parallel.trainer import DeviceEpochCache
-        from mmlspark_tpu.train.learners import _pad_xyw
-        padded = int(math.ceil(n / bs) * bs)
-        stand_in = {
-            "x": np.broadcast_to(np.float32(0), (padded, d)),
-            "y": np.broadcast_to(np.zeros((), self._y_dtype), (padded,)),
-            "w": np.broadcast_to(np.float32(0), (padded,))}
-        if mode == "auto" and not DeviceEpochCache.fits(stand_in,
-                                                       shuffle=True):
-            return None
-        x = np.asarray(frame.column(fcol), dtype=np.float32)
-        y = np.asarray(frame.column(lcol))
-        xp, yp, wp = _pad_xyw({fcol: x, lcol: y}, fcol, lcol, padded,
-                              self._y_dtype)
-        return DeviceEpochCache({"x": xp, "y": yp, "w": wp}, bs, mesh=mesh,
-                                shuffle=True, seed=self.seed)
+        from mmlspark_tpu.train.learners import _epoch_device_cache
+        return _epoch_device_cache(frame, fcol, lcol, bs, self._y_dtype,
+                                   mesh=mesh, seed=self.seed,
+                                   force=mode == "on")
 
     # -- task hooks (subclass responsibility) -------------------------------
     def _n_out(self, frame: Frame, ymax, ymu, ysigma) -> int:
@@ -251,7 +236,7 @@ class _DeepEstimatorBase(JaxEstimator):
         step, last_loss = done, None
 
         # a fully-resumed fit runs zero steps — don't pay the epoch transfer
-        cache = (self._make_device_cache(frame, fcol, lcol, bs, mesh, n, d)
+        cache = (self._make_device_cache(frame, fcol, lcol, bs, mesh)
                  if done < total_steps else None)
 
         def host_batches():

@@ -153,6 +153,42 @@ def _pad_xyw(hb: Dict[str, np.ndarray], fcol: str, lcol: str, bs: int,
     return x, y, w
 
 
+def _epoch_device_cache(frame: Frame, fcol: str, lcol: str, batch_size: int,
+                        y_dtype, mesh=None, seed: int = 0,
+                        force: bool = False):
+    """Pad-and-masked epoch -> shuffled DeviceEpochCache, or None when it
+    exceeds the ``runtime.device_cache_mb`` budget (unless ``force``).
+
+    THE single constructor behind the deep estimators' ``deviceCache`` and
+    the built-in learners' epoch residency. The budget check runs on
+    shape/dtype stand-ins so an over-budget frame costs no host
+    materialization; the tail rows are padded ONCE with zero weight and
+    ride through every shuffled epoch masked out of the loss. Single-batch
+    epochs skip the shuffle: batch composition is invariant under
+    permutation and the per-epoch gather isn't free.
+    """
+    from mmlspark_tpu.parallel.trainer import DeviceEpochCache
+    n = frame.count()
+    if n == 0:
+        raise ValueError("empty frame")
+    d = np.asarray(frame.head(1)[0][fcol]).size
+    padded = int(np.ceil(n / batch_size) * batch_size)
+    shuffle = padded > batch_size
+    stand_in = {
+        "x": np.broadcast_to(np.float32(0), (padded, d)),
+        "y": np.broadcast_to(np.zeros((), y_dtype), (padded,)),
+        "w": np.broadcast_to(np.float32(0), (padded,))}
+    if not force and not DeviceEpochCache.fits(stand_in, shuffle=shuffle):
+        return None
+    x = np.asarray(frame.column(fcol), np.float32)
+    y = np.asarray(frame.column(lcol))
+    epoch = dict(zip(("x", "y", "w"),
+                     _pad_xyw({fcol: x, lcol: y}, fcol, lcol, padded,
+                              y_dtype)))
+    return DeviceEpochCache(epoch, batch_size, mesh=mesh, shuffle=shuffle,
+                            seed=seed)
+
+
 def _stream_adam(loss_fn: Callable, params: Any, frame: Frame,
                  fcol: str, lcol: str, *, lr: float, max_steps: int,
                  batch_size: int, y_dtype=np.int32, seed: int = 0) -> Any:
@@ -182,31 +218,12 @@ def _stream_adam(loss_fn: Callable, params: Any, frame: Frame,
         updates, s = opt.update(g, s, p)
         return optax.apply_updates(p, updates), s, loss
 
-    from mmlspark_tpu.parallel.trainer import DeviceEpochCache
-    n = frame.count()
-    if n == 0:
-        raise ValueError("empty frame")
-    d = np.asarray(frame.head(1)[0][fcol]).size
-    padded = int(np.ceil(n / batch_size) * batch_size)
-    # budget-check on shape/dtype stand-ins — the epoch is only
-    # materialized when it will actually be cached
-    stand_in = {
-        "x": np.broadcast_to(np.float32(0), (padded, d)),
-        "y": np.broadcast_to(np.zeros((), y_dtype), (padded,)),
-        "w": np.broadcast_to(np.float32(0), (padded,))}
+    from jax.sharding import Mesh
+    one_dev = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    cache = _epoch_device_cache(frame, fcol, lcol, batch_size, y_dtype,
+                                mesh=one_dev, seed=seed)
     steps = 0
-    if DeviceEpochCache.fits(stand_in, shuffle=padded > batch_size):
-        x_all = np.asarray(frame.column(fcol), np.float32)
-        y_all = np.asarray(frame.column(lcol))
-        epoch = dict(zip(("x", "y", "w"),
-                         _pad_xyw({fcol: x_all, lcol: y_all}, fcol, lcol,
-                                  padded, y_dtype)))
-        from jax.sharding import Mesh
-        one_dev = Mesh(np.asarray(jax.devices()[:1]), ("data",))
-        # a single-batch epoch needs no shuffle: batch composition is
-        # invariant under permutation and the per-epoch gather isn't free
-        cache = DeviceEpochCache(epoch, batch_size, mesh=one_dev,
-                                 shuffle=padded > batch_size, seed=seed)
+    if cache is not None:
         # commit state to the cache's mesh up front: otherwise step 1 runs
         # with uncommitted params, step 2 with committed outputs — two
         # compiles of the same step

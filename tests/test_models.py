@@ -83,6 +83,18 @@ def test_jax_model_minibatch_padding_consistency():
     np.testing.assert_allclose(outs[0], outs[2], atol=2e-2)
 
 
+def test_jax_model_many_batches_crosses_put_windows():
+    """Scoring with dozens of minibatches (several transfer windows + an
+    output-retire window + a padded tail) must equal single-batch scoring."""
+    f = make_image_frame(n=83)  # 42 batches of 2: crosses put_window=8 x5
+    small = JaxModel(inputCol="img", outputCol="o", miniBatchSize=2)
+    small.set_model("vit_tiny", num_classes=4, image_size=8, patch=4, seed=1)
+    big = JaxModel(inputCol="img", outputCol="o", miniBatchSize=128)
+    big.set_model("vit_tiny", num_classes=4, image_size=8, patch=4, seed=1)
+    np.testing.assert_allclose(small.transform(f).column("o"),
+                               big.transform(f).column("o"), atol=2e-2)
+
+
 def test_jax_model_output_node_selection():
     f = make_image_frame(n=4)
     m = JaxModel(inputCol="img", outputCol="feat", miniBatchSize=4,
