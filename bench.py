@@ -215,6 +215,12 @@ def config_train() -> dict:
 # -- config "eval": JaxModel minibatch scoring (CNTKModel parity) ------------
 
 def config_eval() -> dict:
+    """CNTKModel-parity minibatch scoring. The framework scores the raw
+    uint8 image column — its wire format keeps uint8 across host->HBM (1/4
+    the bytes) and casts on device, where the reference marshaled fp32
+    FloatVectorVectors (``CNTKModel.scala:63-78``). The baseline is the
+    conventional inline loop: fp32 tensors, one put + apply + get per
+    batch. Same model, same rows, same outputs."""
     import jax
     import jax.numpy as jnp
     from mmlspark_tpu.core.frame import Frame
@@ -227,7 +233,7 @@ def config_eval() -> dict:
 
     jm = JaxModel(inputCol="features", outputCol="scored", miniBatchSize=bs)
     jm.set_model("resnet20_cifar", num_classes=10, seed=0)
-    frame = Frame.from_dict({"features": feats}, num_partitions=8)
+    frame = Frame.from_dict({"features": images}, num_partitions=8)
 
     jm.transform(frame)  # warmup: compile + one full pass
 
