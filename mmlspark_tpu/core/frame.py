@@ -12,7 +12,11 @@ equivalent of the reference's broadcast + ``mapPartitions`` minibatch loop
 Storage conventions per DType:
   numeric  -> 1-D ndarray of the numpy dtype
   STRING   -> 1-D object ndarray of str (None for missing)
-  VECTOR   -> 2-D float32 ndarray (n_rows, dim)
+  VECTOR   -> 2-D ndarray (n_rows, dim); float32 canonical, uint8 permitted
+              (the raw-bytes wire format: 1/4 the host->HBM traffic, cast
+              on device). Storage dtype is UNIFORM across partitions —
+              Frame.__init__ enforces it, so consumers that cast must cast
+              (uint8 arithmetic wraps) but never see mixed batches.
   IMAGE    -> 1-D object ndarray of schema.ImageValue
   BINARY   -> 1-D object ndarray of bytes
   TOKENS   -> 1-D object ndarray of list[str]
@@ -67,7 +71,10 @@ def _normalize(values: Any, dtype: Optional[DType] = None) -> Tuple[np.ndarray, 
     else:
         lst = list(values)
         if lst and isinstance(lst[0], np.ndarray) and dtype in (None, DType.VECTOR):
-            arr = np.stack([np.asarray(v, dtype=np.float32) for v in lst])
+            all_u8 = all(isinstance(v, np.ndarray) and v.dtype == np.uint8
+                         for v in lst)
+            elem = np.uint8 if all_u8 else np.float32
+            arr = np.stack([np.asarray(v, dtype=elem) for v in lst])
         else:
             numeric = (bool(lst)
                        and any(v is not None for v in lst)
@@ -98,7 +105,12 @@ def _normalize(values: Any, dtype: Optional[DType] = None) -> Tuple[np.ndarray, 
     else:
         dim = int(arr.shape[1]) if arr.ndim == 2 else None
     if dtype == DType.VECTOR and arr.ndim == 2 and arr.dtype != np.float32:
-        arr = arr.astype(np.float32)
+        # uint8 vectors keep their storage dtype: the raw-bytes wire format
+        # crosses host->HBM at 1/4 the fp32 size and consumers (JaxModel,
+        # the fused preprocess) cast on device. Everything else stores as
+        # the canonical float32.
+        if arr.dtype != np.uint8:
+            arr = arr.astype(np.float32)
     elif dtype.is_numeric and arr.dtype != dtype.numpy_dtype and arr.dtype != np.object_:
         if (np.issubdtype(arr.dtype, np.floating)
                 and np.issubdtype(dtype.numpy_dtype, np.integer)
@@ -115,7 +127,9 @@ class Frame:
 
     def __init__(self, schema: Schema, partitions: List[Partition]):
         self.schema = schema
-        self.partitions = partitions if partitions else [
+        # own the list (not its dicts): _unify_vector_dtypes may replace
+        # entries copy-on-write without touching a caller-shared list
+        self.partitions = list(partitions) if partitions else [
             {c.name: _empty_column(c) for c in schema}]
         # memo for multi-partition column() concatenations (partitions are
         # immutable-by-convention, so the gather never goes stale)
@@ -124,6 +138,41 @@ class Frame:
             lens = {len(part[c.name]) for c in schema}
             if len(lens) > 1:
                 raise SchemaError(f"ragged partition: column lengths {lens}")
+        self._unify_vector_dtypes()
+
+    def _unify_vector_dtypes(self) -> None:
+        """One storage dtype per VECTOR column across ALL partitions.
+
+        uint8 survives only when every non-empty partition agrees (the
+        raw-bytes wire format); any divergence — a per-partition
+        ``with_column`` that produced float rows somewhere, a union with a
+        float frame — canonicalizes the whole column to float32. Without
+        this, a batch's dtype would depend on which partitions it spans and
+        a jitted consumer would silently retrace mid-stream. Empty
+        partitions don't vote but are re-typed to match.
+        """
+        for c in self.schema:
+            if c.dtype != DType.VECTOR:
+                continue
+            # only dense 2-D storage participates: a VECTOR column can also
+            # arrive as a 1-D object array (list-of-lists input, ragged
+            # map_partitions output) which astype cannot densify — leave it
+            # for the consumer-side np.asarray, as before this pass existed
+            dense = [part[c.name] for part in self.partitions
+                     if part[c.name].ndim == 2
+                     and part[c.name].dtype != np.object_]
+            if len(dense) != len(self.partitions):
+                continue
+            dts = {a.dtype for a in dense if len(a)}
+            target = (np.dtype(np.uint8) if dts == {np.dtype(np.uint8)}
+                      else np.dtype(np.float32))
+            for i, part in enumerate(self.partitions):
+                if part[c.name].dtype != target:
+                    # copy-on-write: partition dicts may be shared with
+                    # sibling frames that must keep their own storage
+                    part = dict(part)
+                    part[c.name] = part[c.name].astype(target)
+                    self.partitions[i] = part
 
     # -- constructors ------------------------------------------------------
     @staticmethod

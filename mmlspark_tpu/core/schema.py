@@ -29,7 +29,9 @@ class DType(str, enum.Enum):
     FLOAT64 = "float64"
     STRING = "string"
     BINARY = "binary"      # bytes per row (reference BinaryFileSchema)
-    VECTOR = "vector"      # fixed-dim float32 vector per row (2D ndarray storage)
+    VECTOR = "vector"      # fixed-dim vector per row (2D ndarray storage;
+                           # float32 canonical, uint8 permitted as the
+                           # raw-bytes wire format — cast before arithmetic)
     IMAGE = "image"        # decoded image struct per row (reference ImageSchema)
     TOKENS = "tokens"      # list[str] per row (tokenizer output)
 

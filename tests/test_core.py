@@ -105,6 +105,30 @@ def test_frame_vector_column():
     assert f.schema["v"].dim == 3
 
 
+def test_frame_uint8_vector_column_preserves_dtype():
+    """uint8 vector columns keep their storage dtype (the raw-bytes wire
+    format: 1/4 the host->HBM traffic; consumers cast on device). Other
+    dtypes still canonicalize to float32."""
+    u8 = np.arange(12, dtype=np.uint8).reshape(4, 3)
+    f = Frame.from_dict({"v": u8})
+    assert f.schema["v"].dtype == DType.VECTOR
+    assert f.column("v").dtype == np.uint8
+    np.testing.assert_array_equal(f.column("v"), u8)
+    # list-of-ndarray construction preserves it too
+    f2 = Frame.from_dict({"v": [u8[0], u8[1]]})
+    assert f2.column("v").dtype == np.uint8
+    # float64 input still canonicalizes
+    f3 = Frame.from_dict({"v": u8.astype(np.float64)})
+    assert f3.column("v").dtype == np.float32
+    # mixed-dtype partitions unify to float32 (one storage dtype per
+    # column — a batch's dtype must not depend on which partitions it spans)
+    mixed = f.union(f3)
+    assert {p["v"].dtype for p in mixed.partitions} == {np.dtype(np.float32)}
+    np.testing.assert_array_equal(mixed.column("v")[:4], u8)
+    # the uint8 source frame kept its own storage (copy-on-write)
+    assert f.column("v").dtype == np.uint8
+
+
 def test_frame_repartition_roundtrip(basic_frame):
     f = basic_frame.repartition(3)
     assert f.num_partitions == 3

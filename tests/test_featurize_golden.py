@@ -1,0 +1,119 @@
+"""Golden-file featurization regression: exact output vectors.
+
+The reference pins row-level Featurize outputs in checked-in datasets —
+``featurize/src/test/scala/benchmark{BasicDataTypes,OneHot,NoOneHot,String,
+StringMissing,Vectors}.json`` read by ``VerifyFeaturize`` — so any change to
+column classification, hashing, slot selection, one-hot layout, or assembly
+order breaks the build. Same harness here: each variant in
+``tests/data/featurize_golden.json`` refits on a fixed frame and the exact
+vectors are compared. A deliberate semantic change must consciously
+re-baseline:
+
+    python -m tests.test_featurize_golden   # regenerates the JSON
+"""
+import json
+import os
+
+import numpy as np
+
+from mmlspark_tpu.core.frame import Frame
+from mmlspark_tpu.feature.featurize import AssembleFeatures
+from mmlspark_tpu.feature.value_indexer import ValueIndexer
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+GOLDEN = os.path.join(DATA, "featurize_golden.json")
+
+
+def _basic_types_frame():
+    # int, float, bool-as-string, plain numerics (benchmarkBasicDataTypes)
+    return Frame.from_dict({
+        "i": [1, 2, 3, 4],
+        "f": [0.5, -1.25, 3.0, 2.5],
+        "g": [10.0, 20.0, 30.0, 40.0],
+    })
+
+
+def _categorical_frame():
+    f = Frame.from_dict({
+        "x": [1.0, 2.0, 3.0, 4.0],
+        "c": ["red", "blue", "red", "green"],
+    })
+    f = ValueIndexer(inputCol="c", outputCol="ci").fit(f).transform(f)
+    return f.drop("c")
+
+
+def _string_frame():
+    return Frame.from_dict({
+        "n": [1.0, 2.0, 3.0],
+        "text": ["foo bar", "foo", "baz foo"],
+    })
+
+
+def _string_missing_frame():
+    return Frame.from_dict({
+        "n": [1.0, 2.0, 3.0],
+        "text": ["foo bar", None, "baz"],
+    })
+
+
+def _vectors_frame():
+    f = Frame.from_dict({"n": [1.0, 2.0]})
+    import numpy as _np
+    from mmlspark_tpu.core.schema import ColumnSchema, DType
+    return f.with_column_values(
+        ColumnSchema("vec", DType.VECTOR, 3),
+        _np.asarray([[0.0, 1.0, 2.0], [3.0, 4.0, 5.0]], _np.float32))
+
+
+VARIANTS = {
+    # name -> (frame builder, AssembleFeatures kwargs)
+    "basic_types": (_basic_types_frame, {"columnsToFeaturize": ["i", "f", "g"]}),
+    "one_hot": (_categorical_frame, {"columnsToFeaturize": ["x", "ci"]}),
+    "no_one_hot": (_categorical_frame,
+                   {"columnsToFeaturize": ["x", "ci"],
+                    "oneHotEncodeCategoricals": False}),
+    "string_hash": (_string_frame,
+                    {"columnsToFeaturize": ["n", "text"],
+                     "numberOfFeatures": 1 << 18}),
+    "string_missing": (_string_missing_frame,
+                       {"columnsToFeaturize": ["n", "text"]}),
+    "vectors": (_vectors_frame, {"columnsToFeaturize": ["n", "vec"]}),
+}
+
+
+def _compute(name):
+    build, kwargs = VARIANTS[name]
+    frame = build()
+    model = AssembleFeatures(featuresCol="features", **kwargs).fit(frame)
+    out = model.transform(frame)
+    return np.asarray(out.column("features"), np.float64)
+
+
+def test_featurize_golden_vectors():
+    assert os.path.exists(GOLDEN), (
+        f"{GOLDEN} missing: run `python -m tests.test_featurize_golden`")
+    with open(GOLDEN) as fh:
+        golden = json.load(fh)
+    assert set(golden) == set(VARIANTS), (
+        "variant set changed: regenerate the golden file")
+    for name in sorted(VARIANTS):
+        got = _compute(name)
+        want = np.asarray(golden[name], np.float64)
+        assert got.shape == want.shape, (
+            f"{name}: featurized shape {got.shape} != golden {want.shape}")
+        np.testing.assert_allclose(
+            got, want, atol=1e-9,
+            err_msg=f"{name}: featurized vectors drifted from golden file")
+
+
+def main():
+    out = {name: _compute(name).tolist() for name in sorted(VARIANTS)}
+    with open(GOLDEN, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(f"wrote {GOLDEN}")
+    for name, rows in out.items():
+        print(f"  {name}: {len(rows)} rows x {len(rows[0])}")
+
+
+if __name__ == "__main__":
+    main()
