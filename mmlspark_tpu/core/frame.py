@@ -154,24 +154,34 @@ class Frame:
         for c in self.schema:
             if c.dtype != DType.VECTOR:
                 continue
-            # only dense 2-D storage participates: a VECTOR column can also
-            # arrive as a 1-D object array (list-of-lists input, ragged
-            # map_partitions output) which astype cannot densify — leave it
-            # for the consumer-side np.asarray, as before this pass existed
-            dense = [part[c.name] for part in self.partitions
-                     if part[c.name].ndim == 2
-                     and part[c.name].dtype != np.object_]
-            if len(dense) != len(self.partitions):
+            # Only dense 2-D ndarray storage participates: a VECTOR column
+            # can also arrive as a 1-D object array or plain list
+            # (list-of-lists input, duck-typed map_partitions output) which
+            # astype cannot densify — those are left for the consumer-side
+            # np.asarray, as before this pass existed.
+            vals = [part[c.name] for part in self.partitions]
+            dense_idx = [i for i, a in enumerate(vals)
+                         if isinstance(a, np.ndarray) and a.ndim == 2
+                         and a.dtype != np.object_]
+            if not dense_idx:
                 continue
-            dts = {a.dtype for a in dense if len(a)}
-            target = (np.dtype(np.uint8) if dts == {np.dtype(np.uint8)}
+            dts = {vals[i].dtype for i in dense_idx if len(vals[i])}
+            if not dts:
+                continue  # all-empty: keep dtypes (a filtered-to-empty
+                # uint8 frame must not silently flip to float32)
+            # uint8 survives only when EVERY partition is dense uint8;
+            # object/ragged partitions break purity, so dense ones
+            # canonicalize to float32
+            target = (np.dtype(np.uint8)
+                      if len(dense_idx) == len(vals)
+                      and dts == {np.dtype(np.uint8)}
                       else np.dtype(np.float32))
-            for i, part in enumerate(self.partitions):
-                if part[c.name].dtype != target:
+            for i in dense_idx:
+                if vals[i].dtype != target:
                     # copy-on-write: partition dicts may be shared with
                     # sibling frames that must keep their own storage
-                    part = dict(part)
-                    part[c.name] = part[c.name].astype(target)
+                    part = dict(self.partitions[i])
+                    part[c.name] = vals[i].astype(target)
                     self.partitions[i] = part
 
     # -- constructors ------------------------------------------------------

@@ -127,6 +127,20 @@ def test_frame_uint8_vector_column_preserves_dtype():
     np.testing.assert_array_equal(mixed.column("v")[:4], u8)
     # the uint8 source frame kept its own storage (copy-on-write)
     assert f.column("v").dtype == np.uint8
+    # filtering to zero rows must NOT flip storage to float32
+    empty = f.filter(lambda p: np.zeros(len(p["v"]), bool))
+    assert {p["v"].dtype for p in empty.partitions} == {np.dtype(np.uint8)}
+    # mixed dense + object partitions: dense ones unify to float32
+    from mmlspark_tpu.core.schema import ColumnSchema, DType as DT, Schema as S
+    obj = np.empty(2, dtype=object)
+    obj[0], obj[1] = [1.0, 2.0, 3.0], [4.0, 5.0, 6.0]
+    mixed2 = Frame(S([ColumnSchema("v", DT.VECTOR, 3)]),
+                   [{"v": u8[:2]}, {"v": obj}])
+    assert mixed2.partitions[0]["v"].dtype == np.float32
+    # duck-typed map_partitions output (plain list) must not crash __init__
+    listy = Frame(S([ColumnSchema("v", DT.VECTOR, 2)]),
+                  [{"v": [[1.0, 2.0], [3.0, 4.0]]}])
+    assert listy.count() == 2
 
 
 def test_frame_repartition_roundtrip(basic_frame):

@@ -17,6 +17,7 @@ import os
 import numpy as np
 
 from mmlspark_tpu.core.frame import Frame
+from mmlspark_tpu.core.schema import ColumnSchema, DType
 from mmlspark_tpu.feature.featurize import AssembleFeatures
 from mmlspark_tpu.feature.value_indexer import ValueIndexer
 
@@ -58,11 +59,9 @@ def _string_missing_frame():
 
 def _vectors_frame():
     f = Frame.from_dict({"n": [1.0, 2.0]})
-    import numpy as _np
-    from mmlspark_tpu.core.schema import ColumnSchema, DType
     return f.with_column_values(
         ColumnSchema("vec", DType.VECTOR, 3),
-        _np.asarray([[0.0, 1.0, 2.0], [3.0, 4.0, 5.0]], _np.float32))
+        np.asarray([[0.0, 1.0, 2.0], [3.0, 4.0, 5.0]], np.float32))
 
 
 VARIANTS = {
