@@ -47,6 +47,14 @@ def cmd_run(args, passthrough: List[str]) -> int:
     script = args.script
     if not os.path.exists(script):
         raise SystemExit(f"script not found: {script}")
+    saved_platform = None
+    if args.platform:
+        # must land BEFORE the backend initializes; an explicit config
+        # value outranks JAX_PLATFORMS, which ambient site hooks may have
+        # pinned to a different platform
+        import jax
+        saved_platform = (jax.config.jax_platforms,)
+        jax.config.update("jax_platforms", args.platform)
     from mmlspark_tpu.parallel.mesh import initialize_multihost
     try:
         initialize_multihost(coordinator_address=args.coordinator,
@@ -67,6 +75,14 @@ def cmd_run(args, passthrough: List[str]) -> int:
         if args.mesh:
             config.unset("runtime.mesh")
             os.environ.pop("MMLSPARK_TPU_RUNTIME_MESH", None)
+        if saved_platform is not None:
+            # restore the config for in-process callers (the already-live
+            # backend is not torn down, but the next launch decides afresh)
+            import jax
+            try:
+                jax.config.update("jax_platforms", saved_platform[0])
+            except RuntimeError:
+                pass
     return 0
 
 
@@ -117,6 +133,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                        help="host:port of process 0 (multi-host)")
     run_p.add_argument("--num-processes", type=int, default=None)
     run_p.add_argument("--process-id", type=int, default=None)
+    run_p.add_argument("--platform", default=None,
+                       choices=["cpu", "tpu", "gpu"],
+                       help="force the jax platform before the process "
+                       "group forms; outranks env and ambient site hooks "
+                       "— e.g. --platform cpu for the virtual-device test "
+                       "mesh")
     run_p.set_defaults(fn=cmd_run)
 
     info_p = sub.add_parser("info", help="device + config inventory")

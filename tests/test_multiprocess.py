@@ -1,9 +1,11 @@
-"""Real 2-process jax.distributed test — the coverage the reference's
+"""Real 2-process jax.distributed tests — the coverage the reference's
 MultiNodeParallelLauncher stub never had (``CommandBuilders.scala:95-117``).
 
 Two OS processes join a coordination service on localhost, form one global
 device view (2 CPU devices each -> 4 global), and run a cross-process sum
 whose collectives ride Gloo — the single-box stand-in for multi-host DCN.
+Covered twice: through the raw ``initialize_multihost`` API and through the
+``mmlspark-tpu run`` launcher (the spark-submit-style UX).
 """
 import os
 import socket
@@ -39,6 +41,31 @@ _WORKER = textwrap.dedent("""
     print(f"proc {pid} ok {val}")
 """)
 
+_CLI_WORKER = textwrap.dedent("""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from mmlspark_tpu.parallel.mesh import device_count_summary
+    from mmlspark_tpu.utils import config
+
+    # the launcher already joined the process group and parked --mesh in
+    # the config tier before this script ran
+    info = device_count_summary()
+    assert info["process_count"] == 2, info
+    assert info["global_devices"] == 4, info
+    assert config.get("runtime.mesh") == "data=-1", config.get("runtime.mesh")
+    pid = jax.process_index()
+    mesh = Mesh(np.asarray(jax.devices()).reshape(4), ("data",))
+    x = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")),
+        np.full((2,), pid + 1.0, np.float32), (4,))
+    total = jax.jit(lambda a: a.sum(),
+                    out_shardings=NamedSharding(mesh, P()))(x)
+    val = float(jax.device_get(total.addressable_data(0)))
+    assert val == 6.0, val
+    print(f"cli proc {pid} ok {val}")
+""")
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -46,26 +73,64 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _launch_pair(argv_for, env_overrides=None, timeout: int = 180):
+    """Spawn two worker processes, reap both (killing stragglers on a
+    timeout so a hung rendezvous can't leak orphans holding the
+    coordinator port), and return their outputs."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    # the worker script may live outside the repo; the package may not be
+    # pip-installed
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_overrides or {})
+    procs = [subprocess.Popen(argv_for(i), env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return procs, outs
+
+
 @pytest.mark.slow
 def test_two_process_distributed_psum(tmp_path):
     worker = tmp_path / "worker.py"
     worker.write_text(_WORKER)
     port = str(_free_port())
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    # the worker script lives in tmp_path, so sys.path won't include the
-    # repo root unless we say so (the package may not be pip-installed)
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [subprocess.Popen(
-        [sys.executable, str(worker), str(i), port],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        text=True) for i in range(2)]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=180)
-        outs.append(out)
+    procs, outs = _launch_pair(
+        lambda i: [sys.executable, str(worker), str(i), port],
+        env_overrides={"JAX_PLATFORMS": "cpu"})
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
         assert f"proc {i} ok 6.0" in out
+
+
+@pytest.mark.slow
+def test_cli_launcher_two_process_run(tmp_path):
+    """The spark-submit-style UX end to end: two ``mmlspark-tpu run``
+    invocations join one process group, see the --mesh flag through the
+    config tier, and run a cross-process collective. JAX_PLATFORMS is set
+    to a bogus value so the test only passes if --platform actually
+    outranks the environment (its stated contract) — the launcher-level
+    counterpart of the raw-API test above (reference ``tools/bin/mml-exec``
+    + ``CommandBuilders.scala:95-117``)."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_CLI_WORKER)
+    port = str(_free_port())
+    procs, outs = _launch_pair(
+        lambda i: [sys.executable, "-m", "mmlspark_tpu.cli", "run",
+                   str(worker), "--mesh", "data=-1", "--platform", "cpu",
+                   "--coordinator", f"127.0.0.1:{port}",
+                   "--num-processes", "2", "--process-id", str(i)],
+        env_overrides={"JAX_PLATFORMS": "definitely_not_a_backend"})
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert f"cli proc {i} ok 6.0" in out
