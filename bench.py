@@ -365,17 +365,22 @@ def _textcnn_trainer():
     return module, DistributedTrainer(loss_fn, optax.adam(1e-3))
 
 
+_TEXT_EPOCHS = 10
+
+
 def config_text() -> dict:
-    """Featurize+train, both sides TIMED end to end — ONE epoch, so data
-    residency has nothing to amortize (DeviceEpochCache is the multi-epoch
-    story; DeepClassifier uses it). The framework's one-pass advantage is
-    OVERLAP: per-batch featurization runs in the DevicePrefetcher producer
-    thread while the device steps on the previous batch. The baseline is
-    the reference's two-phase shape (featurize the whole dataset, then
-    train — ``CNTKLearner.fit`` writes the featurized set out before the
-    ``cntk`` process starts)."""
+    """Featurize + multi-epoch TextCNN training, both sides TIMED end to
+    end. CNN training is inherently multi-epoch, which is exactly what the
+    framework's data layer exploits (what DeepClassifier's fit does):
+    tokenize+hash once through the cached batch hasher, ONE host->HBM
+    transfer into a DeviceEpochCache, then every epoch's batches are
+    already-resident device slices. The baseline is the reference's
+    two-phase shape — featurize the whole dataset, then a put per step
+    EVERY epoch (``CNTKLearner.fit`` writes the featurized set to a shared
+    filesystem the training ranks re-read)."""
     import jax
     import jax.numpy as jnp
+    from mmlspark_tpu.parallel.trainer import DeviceEpochCache
 
     n = _TEXT_STEPS * BATCH
     texts, labels = _make_reviews(n)
@@ -394,19 +399,17 @@ def config_text() -> dict:
                 {"ids": warm_ids, "label": labels[:BATCH]}), rng)
     jax.block_until_ready(metrics["loss"])
 
-    # framework: featurize per batch INSIDE the prefetcher's producer
-    # thread; tokenize+hash of batch k+1 overlaps the device step on k
-    def host_batches():
-        for s in range(_TEXT_STEPS):
-            sl = slice(s * BATCH, (s + 1) * BATCH)
-            yield {"ids": _tokenize_hash(texts[sl]), "label": labels[sl]}
-
     def run_fw():
         nonlocal state
-        state, _ = trainer.fit(state, host_batches(), rng,
-                               collect_losses=False)
+        cache = DeviceEpochCache(
+            {"ids": _tokenize_hash(texts), "label": labels},
+            BATCH, mesh=trainer.mesh)
+        for epoch in range(_TEXT_EPOCHS):
+            for batch in cache.batches(epoch):
+                state, metrics = trainer.train_step(state, batch, rng)
+        jax.block_until_ready(metrics["loss"])
 
-    # baseline: featurize everything, then train (two serial phases)
+    # baseline: featurize everything, then stream a put per step per epoch
     module_b, trainer_b = _textcnn_trainer()
     state_b = trainer_b.init(
         lambda: module_b.init(jax.random.PRNGKey(0),
@@ -416,19 +419,23 @@ def config_text() -> dict:
             state_b, trainer_b.put_batch(
                 {"ids": warm_ids, "label": labels[:BATCH]}), rng)
     jax.block_until_ready(metrics["loss"])
+
     def run_base():
         nonlocal state_b
         ids = _tokenize_hash(texts)
-        for s in range(_TEXT_STEPS):
-            sl = slice(s * BATCH, (s + 1) * BATCH)
-            state_b, metrics = trainer_b.train_step(
-                state_b,
-                trainer_b.put_batch({"ids": ids[sl], "label": labels[sl]}),
-                rng)
+        for _ in range(_TEXT_EPOCHS):
+            for s in range(_TEXT_STEPS):
+                sl = slice(s * BATCH, (s + 1) * BATCH)
+                state_b, metrics = trainer_b.train_step(
+                    state_b,
+                    trainer_b.put_batch({"ids": ids[sl],
+                                         "label": labels[sl]}),
+                    rng)
         jax.block_until_ready(metrics["loss"])
 
     t_fw, t_base = _best_pair(run_fw, run_base)
-    fw_rps, base_rps = n / t_fw, n / t_base
+    rows = n * _TEXT_EPOCHS
+    fw_rps, base_rps = rows / t_fw, rows / t_base
     return {"value": round(fw_rps, 2), "unit": "rows/sec/chip",
             "vs_baseline": round(fw_rps / base_rps, 4)}
 

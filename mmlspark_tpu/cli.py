@@ -48,30 +48,38 @@ def cmd_run(args, passthrough: List[str]) -> int:
     if not os.path.exists(script):
         raise SystemExit(f"script not found: {script}")
     saved_platform = None
-    if args.platform:
-        # must land BEFORE the backend initializes; an explicit config
-        # value outranks JAX_PLATFORMS, which ambient site hooks may have
-        # pinned to a different platform
-        import jax
-        saved_platform = (jax.config.jax_platforms,)
-        jax.config.update("jax_platforms", args.platform)
-    from mmlspark_tpu.parallel.mesh import initialize_multihost
+    # main() is also an importable in-process API (tests, notebooks) — every
+    # mutation below is restored in the finally, whether the failure is in
+    # the process-group join or the script itself (it is scoped to this
+    # launch, not the process)
     try:
-        initialize_multihost(coordinator_address=args.coordinator,
-                             num_processes=args.num_processes,
-                             process_id=args.process_id)
-    except ValueError as e:
-        raise SystemExit(str(e))
-    # main() is also an importable in-process API (tests, notebooks) —
-    # restore the interpreter state the script run mutates, including the
-    # mesh override (it is scoped to this launch, not the process)
-    saved_argv, saved_path = sys.argv, list(sys.path)
-    sys.argv = [script] + passthrough
-    sys.path.insert(0, os.path.dirname(os.path.abspath(script)))
-    try:
-        runpy.run_path(script, run_name="__main__")
+        if args.platform:
+            # must land BEFORE the backend initializes; an explicit config
+            # value outranks JAX_PLATFORMS, which ambient site hooks may
+            # have pinned to a different platform
+            import jax
+            saved_platform = (jax.config.jax_platforms,)
+            try:
+                jax.config.update("jax_platforms", args.platform)
+            except RuntimeError as e:
+                # backend already live (in-process caller touched JAX
+                # first): the platform can no longer be forced
+                raise SystemExit(f"--platform: {e}")
+        from mmlspark_tpu.parallel.mesh import initialize_multihost
+        try:
+            initialize_multihost(coordinator_address=args.coordinator,
+                                 num_processes=args.num_processes,
+                                 process_id=args.process_id)
+        except ValueError as e:
+            raise SystemExit(str(e))
+        saved_argv, saved_path = sys.argv, list(sys.path)
+        sys.argv = [script] + passthrough
+        sys.path.insert(0, os.path.dirname(os.path.abspath(script)))
+        try:
+            runpy.run_path(script, run_name="__main__")
+        finally:
+            sys.argv, sys.path[:] = saved_argv, saved_path
     finally:
-        sys.argv, sys.path[:] = saved_argv, saved_path
         if args.mesh:
             config.unset("runtime.mesh")
             os.environ.pop("MMLSPARK_TPU_RUNTIME_MESH", None)

@@ -126,6 +126,21 @@ class DevicePrefetcher:
         return self._put(item)
 
 
+_INDEX_JIT = None
+
+
+def _shared_index_jit():
+    """One process-wide jitted epoch slicer, shared by every cache
+    instance: a per-instance ``jax.jit(lambda ...)`` would re-trace (and
+    on remote-compile backends re-compile) for every fresh cache even
+    though the program is identical."""
+    global _INDEX_JIT
+    if _INDEX_JIT is None:
+        _INDEX_JIT = jax.jit(
+            lambda d, i: jax.tree_util.tree_map(lambda a: a[i], d))
+    return _INDEX_JIT
+
+
 class DeviceEpochCache:
     """Device-resident epoch: one host->HBM transfer, batches sliced on device.
 
@@ -184,8 +199,7 @@ class DeviceEpochCache:
             # Only ever called from _materialize, i.e. while the device is
             # idle — the per-call scalar transfer for the Python index is
             # harmless there (steady-state consumption touches no jit).
-            self._index = jax.jit(
-                lambda d, i: jax.tree_util.tree_map(lambda a: a[i], d))
+            self._index = _shared_index_jit()
             if shuffle:
                 self._base = base
                 self._batches = None  # built per epoch in batches()
