@@ -38,15 +38,15 @@ def _parse_mesh(text: str) -> dict:
 
 def cmd_run(args, passthrough: List[str]) -> int:
     from mmlspark_tpu.utils import config
+    script = args.script
+    if not os.path.exists(script):  # before any process-state mutation
+        raise SystemExit(f"script not found: {script}")
     if args.mesh:
         _parse_mesh(args.mesh)  # fail fast on a bad flag
         # config tier: visible to mesh_from_config() in the user script AND
         # to DeepClassifier/DistributedTrainer default mesh resolution
         os.environ["MMLSPARK_TPU_RUNTIME_MESH"] = args.mesh
         config.set("runtime.mesh", args.mesh)
-    script = args.script
-    if not os.path.exists(script):
-        raise SystemExit(f"script not found: {script}")
     saved_platform = None
     # main() is also an importable in-process API (tests, notebooks) — every
     # mutation below is restored in the finally, whether the failure is in
