@@ -153,6 +153,24 @@ def test_device_epoch_cache_batches_match_host():
         assert b["x"].sharding.spec == P(("data",))
 
 
+def test_device_epoch_cache_seq_axis_sharding():
+    """Rank-3 columns (tokens with a sequence dim) shard batch over data
+    AND sequence over seq — the long-context input layout."""
+    from mmlspark_tpu.parallel.trainer import DeviceEpochCache
+    mesh = make_mesh(MeshSpec(data=2, seq=2), devices=jax.devices()[:4])
+    x = np.arange(32 * 8 * 4, dtype=np.float32).reshape(32, 8, 4)
+    cache = DeviceEpochCache({"x": x}, batch_size=8, mesh=mesh,
+                             seq_axis="seq")
+    got = list(cache.batches(0))
+    assert len(got) == 4
+    for i, b in enumerate(got):
+        np.testing.assert_array_equal(np.asarray(b["x"]), x[i * 8:(i + 1) * 8])
+        assert b["x"].sharding.spec == P(("data",), "seq")
+        # 8 rows over data=2, seq dim 8 over seq=2 -> (4, 4, 4) per shard
+        shapes = {s.data.shape for s in b["x"].addressable_shards}
+        assert shapes == {(4, 4, 4)}
+
+
 def test_device_epoch_cache_shuffle_deterministic_and_complete():
     from mmlspark_tpu.parallel.trainer import DeviceEpochCache
     x = np.arange(64, dtype=np.float32).reshape(64, 1)
