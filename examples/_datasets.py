@@ -7,7 +7,19 @@ with the same schema and a learnable signal. Sizes are CPU-test friendly.
 """
 from __future__ import annotations
 
+import os
+import sys
+
 import numpy as np
+
+# Examples must run straight from a checkout (`python examples/101_*.py`)
+# without `pip install -e .`: python puts examples/ on sys.path, not the
+# repo root. Every example imports this module before mmlspark_tpu, so one
+# bootstrap here covers all of them; a pip-installed package wins the
+# import race unaffected.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.append(_REPO)
 
 from mmlspark_tpu.core.frame import Frame
 from mmlspark_tpu.core.schema import ImageValue
