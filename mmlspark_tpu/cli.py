@@ -72,6 +72,24 @@ def cmd_run(args, passthrough: List[str]) -> int:
                                  process_id=args.process_id)
         except ValueError as e:
             raise SystemExit(str(e))
+        if args.platform:
+            # some JAX versions accept jax_platforms updates silently after
+            # the backend is live; verify the live backend actually matches
+            # rather than running the user script on the wrong platform
+            import jax
+            try:
+                backend = jax.default_backend()
+            except RuntimeError as e:
+                # e.g. --platform tpu on a host with no TPU: surface the
+                # launcher's clean error style, not a raw traceback
+                raise SystemExit(f"--platform {args.platform}: {e}")
+            accept = {"gpu": {"gpu", "cuda", "rocm"}}.get(
+                args.platform, {args.platform})
+            if backend not in accept:
+                raise SystemExit(
+                    f"--platform {args.platform}: backend initialized as "
+                    f"{backend!r} (JAX was touched before the launcher "
+                    "could pin the platform)")
         saved_argv, saved_path = sys.argv, list(sys.path)
         sys.argv = [script] + passthrough
         sys.path.insert(0, os.path.dirname(os.path.abspath(script)))

@@ -104,9 +104,16 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
             )
 
             def base(x):
+                was_u8 = x.dtype == jnp.uint8
                 x = _to_float(x.reshape((x.shape[0],) + src))
                 if dst and dst != src[:2]:
                     x = device_resize_bilinear(x, dst[0], dst[1])
+                    if was_u8:
+                        # emulate the host path's uint8 re-quantization
+                        # (image/ops.py _resize_stack clips+rints back to
+                        # uint8), so a dataset mixing fused and host routes
+                        # scores identical images identically
+                        x = jnp.clip(jnp.round(x), 0.0, 255.0)
                 return x
         else:
             base = _to_float

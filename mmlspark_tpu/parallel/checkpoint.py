@@ -22,8 +22,9 @@ latest step and training continues bit-identically (fold_in(step) keys).
 """
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 
@@ -58,6 +59,35 @@ class TrainCheckpointer:
         if every > 0 and step > 0 and step % every == 0:
             return self.save(state, step=step, wait=wait)
         return None
+
+    # -- run metadata -------------------------------------------------------
+    # Small facts about HOW the run draws its data (e.g. the batch-order
+    # mode) that a resume must replay identically but that don't belong in
+    # the sharded state pytree. JSON sidecar next to the checkpoints;
+    # process 0 writes, every process reads.
+    _META = "mmlspark_meta.json"
+
+    def put_meta(self, **meta: Any) -> None:
+        if jax.process_index() != 0:
+            return
+        path = os.path.join(self.directory, self._META)
+        data = self.get_meta()
+        data.update(meta)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+
+    def get_meta(self) -> Dict[str, Any]:
+        # Only a MISSING sidecar means "no metadata" (pre-sidecar
+        # checkpoints); any other read/parse failure must surface — callers
+        # pin resume behavior on this, so silently returning {} would let a
+        # transient storage error flip the batch-order mode.
+        try:
+            with open(os.path.join(self.directory, self._META)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {}
 
     # -- read ---------------------------------------------------------------
     def latest_step(self) -> Optional[int]:

@@ -180,6 +180,13 @@ class DeviceEpochCache:
         self._epoch: Optional[int] = None
 
         keep = self.steps_per_epoch * self.batch_size
+        if keep < n:
+            import warnings
+            warnings.warn(
+                f"DeviceEpochCache drops {n - keep} of {n} rows beyond "
+                f"steps*batch_size ({self.steps_per_epoch}*{self.batch_size});"
+                " pad-and-mask the tail first (learners._pad_xyw) to train on"
+                " every row", stacklevel=2)
         with self.mesh:
             def put(name, x):
                 x = np.ascontiguousarray(

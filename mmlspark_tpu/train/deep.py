@@ -149,14 +149,15 @@ class _DeepEstimatorBase(JaxEstimator):
         return {"x": x, "y": y, "w": w}
 
     def _make_device_cache(self, frame: Frame, fcol: str, lcol: str,
-                           bs: int, mesh):
+                           bs: int, mesh, mode: str = None):
         """DeviceEpochCache over the pad-and-masked epoch, or None.
 
         'auto' caches when the padded epoch fits ``runtime.device_cache_mb``
         (see ``DeviceEpochCache.fits`` for the peak-residency accounting);
         'on' forces it; 'off' streams. Construction is shared with the
-        built-in learners (``learners._epoch_device_cache``)."""
-        mode = self.get("deviceCache")
+        built-in learners (``learners._epoch_device_cache``). ``mode``
+        overrides the ``deviceCache`` param (checkpoint-resume pinning)."""
+        mode = mode if mode is not None else self.get("deviceCache")
         if mode == "off":
             return None
         from mmlspark_tpu.train.learners import _epoch_device_cache
@@ -223,7 +224,7 @@ class _DeepEstimatorBase(JaxEstimator):
             ckpt = TrainCheckpointer(self.checkpointDir)
             state, resumed = ckpt.restore_or_init(trainer, init_params_fn)
         else:
-            state = trainer.init(init_params_fn)
+            state, resumed = trainer.init(init_params_fn), False
 
         steps_per_epoch = math.ceil(n / bs)
         total_steps = steps_per_epoch * self.epochs
@@ -236,8 +237,25 @@ class _DeepEstimatorBase(JaxEstimator):
         step, last_loss = done, None
 
         # a fully-resumed fit runs zero steps — don't pay the epoch transfer
-        cache = (self._make_device_cache(frame, fcol, lcol, bs, mesh)
-                 if done < total_steps else None)
+        cache = None
+        if done < total_steps:
+            mode = None
+            if ckpt is not None and resumed:
+                # The two batch-order modes draw different per-epoch
+                # permutations (host rng vs device fold_in), so resuming in
+                # the other mode would replay/omit different rows in the
+                # partial epoch and break the bit-parity elastic-restart
+                # contract everywhere. Pin to the recorded mode.
+                recorded = ckpt.get_meta().get("batch_order")
+                if recorded == "streamed":
+                    mode = "off"
+                elif recorded == "cached":
+                    mode = "on"
+            cache = self._make_device_cache(frame, fcol, lcol, bs, mesh,
+                                            mode=mode)
+            if ckpt is not None:
+                ckpt.put_meta(
+                    batch_order="cached" if cache is not None else "streamed")
 
         def host_batches():
             """Padded fixed-shape batches, shuffled per epoch. The epoch's

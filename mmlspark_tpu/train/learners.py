@@ -219,7 +219,10 @@ def _stream_adam(loss_fn: Callable, params: Any, frame: Frame,
         return optax.apply_updates(p, updates), s, loss
 
     from jax.sharding import Mesh
-    one_dev = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    # local_devices, not devices: under a multi-process launch the global
+    # device 0 belongs to process 0 only — a mesh pinned to it would make
+    # every other process's device_put raise on a non-addressable device
+    one_dev = Mesh(np.asarray(jax.local_devices()[:1]), ("data",))
     cache = _epoch_device_cache(frame, fcol, lcol, batch_size, y_dtype,
                                 mesh=one_dev, seed=seed)
     steps = 0

@@ -201,6 +201,69 @@ def test_fault_injection_elastic_recovery_bit_parity(tmp_path):
         np.testing.assert_allclose(va, vb, rtol=1e-5, atol=1e-6)
 
 
+def test_meta_sidecar_roundtrip(tmp_path):
+    ckpt = TrainCheckpointer(str(tmp_path / "ck"))
+    assert ckpt.get_meta() == {}
+    ckpt.put_meta(batch_order="cached")
+    ckpt.put_meta(extra=1)  # merge, not overwrite
+    assert ckpt.get_meta() == {"batch_order": "cached", "extra": 1}
+    # a fresh manager over the same dir sees the same sidecar
+    assert TrainCheckpointer(str(tmp_path / "ck")).get_meta()[
+        "batch_order"] == "cached"
+
+
+def test_resume_pins_recorded_batch_order_mode(tmp_path):
+    """A mid-epoch resume must replay the SAME permutation stream even if
+    the deviceCache mode decision would flip between runs (ADVICE r2):
+    interrupt a deviceCache='off' fit, resume with 'auto' (which would
+    cache this tiny frame), and require bit-parity with the uninterrupted
+    'off' run — proof the recorded batch_order overrode 'auto'."""
+    from mmlspark_tpu.core.frame import Frame
+    from mmlspark_tpu.parallel.trainer import DistributedTrainer
+    from mmlspark_tpu.train.deep import DeepClassifier
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(128, 6)).astype(np.float32)
+    y = (X[:, 0] - X[:, 2] > 0).astype(np.int64)
+    frame = Frame.from_dict({"features": X, "label": y})
+
+    def learner(ckdir, mode):
+        l = DeepClassifier(architecture="mlp_tabular",
+                           architectureArgs={"hidden": [16]},
+                           batchSize=32, epochs=3, learningRate=3e-3,
+                           checkpointDir=ckdir, checkpointEvery=1,
+                           deviceCache=mode)
+        l.set_params(featuresCol="features", labelCol="label")
+        return l
+
+    ref = learner(str(tmp_path / "ref"), "off").fit(frame)
+
+    real_step = DistributedTrainer.train_step
+    calls = {"n": 0}
+
+    def faulty_step(self, state, batch, rng_):
+        calls["n"] += 1
+        if calls["n"] == 6:  # mid-epoch-2 (4 steps/epoch)
+            raise _InjectedFault("simulated preemption")
+        return real_step(self, state, batch, rng_)
+
+    ckdir = str(tmp_path / "faulty")
+    DistributedTrainer.train_step = faulty_step
+    try:
+        with pytest.raises(_InjectedFault):
+            learner(ckdir, "off").fit(frame)
+    finally:
+        DistributedTrainer.train_step = real_step
+    assert TrainCheckpointer(ckdir).get_meta()["batch_order"] == "streamed"
+
+    resumed = learner(ckdir, "auto").fit(frame)
+    for (ka, va), (kb, vb) in zip(
+            sorted(_flat(ref._state["params"]).items()),
+            sorted(_flat(resumed._state["params"]).items())):
+        assert ka == kb
+        np.testing.assert_allclose(va, vb, rtol=1e-5, atol=1e-6)
+
+
 def _flat(tree, prefix=""):
     out = {}
     for k, v in tree.items():

@@ -227,6 +227,35 @@ def test_image_featurizer_fused_device_resize_matches_host(rng):
                                host.column("features"), atol=0.15)
 
 
+def test_fused_device_resize_requantizes_like_host_uint8(rng):
+    """The device path must emulate the host path's uint8 re-quantization
+    after resize (ADVICE r2): identical uint8 images scored through the
+    fused route and through the host resize->unroll route must produce the
+    same features up to one gray level of resize rounding."""
+    import jax.numpy as jnp
+    from mmlspark_tpu.image import ops
+    from mmlspark_tpu.ops.pallas_preprocess import device_resize_bilinear
+
+    u8 = rng.integers(0, 256, size=(3, 20, 30, 3), dtype=np.uint8)
+    host = np.stack([ops.resize(im, 8, 8) for im in u8])
+    dev = np.asarray(jnp.clip(jnp.round(
+        device_resize_bilinear(jnp.asarray(u8, jnp.float32), 8, 8)),
+        0, 255)).astype(np.uint8)
+    # both sides rint to uint8; float association may differ by 1 at exact
+    # .5 boundaries, never more
+    assert np.abs(host.astype(int) - dev.astype(int)).max() <= 1
+
+    # end to end: fused-path features == host-uint8-path features
+    f = make_image_frame(rng, n=4, h=20, w=30)
+    feat = ImageFeaturizer(cutOutputLayers=1, miniBatchSize=4)
+    feat.set_model("vit_tiny", num_classes=9, image_size=8, patch=4)
+    fused = feat.transform(f).column("features")
+    resized = ImageTransformer(inputCol="image", outputCol="image") \
+        .resize(8, 8).transform(f)
+    host_feats = feat.transform(resized).column("features")
+    np.testing.assert_allclose(fused, host_feats, atol=0.02)
+
+
 def test_image_featurizer_save_load(rng, tmp_path):
     f = make_image_frame(rng, n=2, h=10, w=10)
     feat = ImageFeaturizer(cutOutputLayers=1, miniBatchSize=2)

@@ -192,8 +192,14 @@ def test_device_epoch_cache_shuffle_deterministic_and_complete():
 def test_device_epoch_cache_drops_tail_and_checks_budget():
     from mmlspark_tpu.parallel.trainer import DeviceEpochCache
     x = np.arange(21, dtype=np.float32).reshape(21, 1)
-    cache = DeviceEpochCache({"x": x}, 8)
+    with pytest.warns(UserWarning, match="drops 5 of 21 rows"):
+        cache = DeviceEpochCache({"x": x}, 8)
     assert cache.steps_per_epoch == 2            # 21 -> 16 rows kept
+    # exact-fit epochs stay silent
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        DeviceEpochCache({"x": x[:16]}, 8)
     assert DeviceEpochCache.fits({"x": x}, budget_mb=1.0)
     assert not DeviceEpochCache.fits({"x": np.zeros((1 << 20, 4))},
                                      budget_mb=1.0)
