@@ -412,6 +412,51 @@ class Frame:
                           for name in self.schema.names})
         return Frame(self.schema, parts)
 
+    def process_shard(self, index: Optional[int] = None,
+                      count: Optional[int] = None,
+                      block_rows: Optional[int] = None) -> "Frame":
+        """This process's row shard for multi-process training.
+
+        Each host keeps only the rows its devices will hold — the
+        TPU-native replacement for the reference's shared-filesystem
+        hand-off where every MPI rank re-read the full dataset
+        (``cntk-train/src/main/scala/DataConversion.scala:106-173``).
+        Shards are balanced within one row/block, which is what the deep
+        estimators' per-epoch quota assumes.
+
+        Default (``block_rows=None``): contiguous split, rows
+        ``[i*n/P, (i+1)*n/P)`` — simplest, order-preserving.
+
+        ``block_rows=b``: block-cyclic — process ``i`` keeps row blocks
+        ``i, i+P, i+2P, ...`` of size ``b``. With ``b`` = the per-process
+        batch share (global batch / P), this is EXACTLY the set of rows a
+        single-process run would place on this host's devices, so a
+        multi-process DeviceEpochCache reproduces the single-process
+        epoch layout bit for bit (the parity contract the multi-process
+        trainer test pins).
+
+        Defaults to this process's index/count from the live ``jax``
+        process group; pass ``index``/``count`` to shard for another
+        topology (e.g. writing per-host files ahead of a launch).
+        """
+        import jax
+        i = jax.process_index() if index is None else int(index)
+        p = jax.process_count() if count is None else int(count)
+        if not 0 <= i < p:
+            raise SchemaError(f"process_shard index {i} outside count {p}")
+        n = self.count()
+        cols = self.collect()
+        if block_rows is None:
+            bounds = np.linspace(0, n, p + 1).astype(int)
+            idx = np.arange(int(bounds[i]), int(bounds[i + 1]))
+        else:
+            if block_rows <= 0:
+                raise SchemaError(f"block_rows must be positive, "
+                                  f"got {block_rows}")
+            idx = np.nonzero((np.arange(n) // block_rows) % p == i)[0]
+        return Frame(self.schema,
+                     [{name: arr[idx] for name, arr in cols.items()}])
+
     def cache(self) -> "Frame":
         """Partitions are already materialized host arrays; kept for API parity
         with the reference's CheckpointData persist (CheckpointData.scala:31-70)."""

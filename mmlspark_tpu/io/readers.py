@@ -55,15 +55,31 @@ def _sample(items: List, ratio: float, seed: int) -> List:
     return [x for x in items if rng.random() < ratio]
 
 
+def _process_slice(items: List, process_shard: bool) -> List:
+    """This process's contiguous slice of a sorted work list (multi-process
+    ingestion: every host lists the same files, reads only its share —
+    the reader-level face of ``Frame.process_shard``)."""
+    if not process_shard:
+        return items
+    import jax
+    i, p = jax.process_index(), jax.process_count()
+    bounds = np.linspace(0, len(items), p + 1).astype(int)
+    return items[bounds[i]:bounds[i + 1]]
+
+
 def iter_binary_entries(path: str, recursive: bool = False,
                         sample_ratio: float = 1.0, inspect_zip: bool = True,
-                        seed: int = 0):
+                        seed: int = 0, process_shard: bool = False):
     """Lazily yield ``(path, bytes)`` one entry at a time.
 
     The streaming core under both the eager Frame readers and the chunked
     ``stream_*`` APIs: only the file LISTING is materialized up front; each
     blob is read (and each zip opened) as the consumer pulls it, so a
     terabyte image corpus streams through O(one file) of memory.
+
+    ``process_shard=True`` keeps only this process's contiguous slice of
+    the sorted file list (a zip counts as one file; its entries stay
+    together) — per-host ingestion for multi-process training.
     """
     if not 0.0 < sample_ratio <= 1.0:
         raise ValueError(f"sample_ratio must be in (0, 1], got {sample_ratio}")
@@ -73,8 +89,9 @@ def iter_binary_entries(path: str, recursive: bool = False,
     # `isZipFile(path) && inspectZip || random < sampleRatio`).
     zips = {f for f in all_files
             if inspect_zip and f.endswith(".zip") and zipfile.is_zipfile(f)}
-    files = sorted(_sample([f for f in all_files if f not in zips],
-                           sample_ratio, seed) + list(zips))
+    files = _process_slice(
+        sorted(_sample([f for f in all_files if f not in zips],
+                       sample_ratio, seed) + list(zips)), process_shard)
     for f in files:
         if f in zips:
             with zipfile.ZipFile(f) as z:
@@ -144,12 +161,14 @@ def _object_array(values: Sequence) -> np.ndarray:
 
 def read_binary_files(path: str, recursive: bool = False,
                       sample_ratio: float = 1.0, inspect_zip: bool = True,
-                      seed: int = 0, num_partitions: int = 1) -> Frame:
-    """Frame with (path, bytes) columns — reference BinaryFileSchema."""
+                      seed: int = 0, num_partitions: int = 1,
+                      process_shard: bool = False) -> Frame:
+    """Frame with (path, bytes) columns — reference BinaryFileSchema.
+    ``process_shard=True``: this host reads only its slice of the file list."""
     paths: List[str] = []
     blobs: List[bytes] = []
     for p, b in iter_binary_entries(path, recursive, sample_ratio,
-                                    inspect_zip, seed):
+                                    inspect_zip, seed, process_shard):
         paths.append(p)
         blobs.append(b)
     frame = Frame.from_dict({"path": paths, "bytes": blobs},
@@ -180,10 +199,12 @@ def _decode_blobs(blobs: Sequence[bytes],
 
 def read_images(path: str, recursive: bool = False, sample_ratio: float = 1.0,
                 inspect_zip: bool = True, seed: int = 0,
-                num_partitions: int = 1, decode_threads: int = 8) -> Frame:
-    """Frame with one IMAGE column named 'image'; undecodable files dropped."""
+                num_partitions: int = 1, decode_threads: int = 8,
+                process_shard: bool = False) -> Frame:
+    """Frame with one IMAGE column named 'image'; undecodable files dropped.
+    ``process_shard=True``: this host reads/decodes only its file slice."""
     binary = read_binary_files(path, recursive, sample_ratio, inspect_zip,
-                               seed, num_partitions)
+                               seed, num_partitions, process_shard)
     dropped = 0
     parts = []
     for p in binary.partitions:
@@ -206,15 +227,17 @@ def read_images(path: str, recursive: bool = False, sample_ratio: float = 1.0,
 
 
 def read_csv(path: str, header: bool = True, num_partitions: int = 1,
-             infer_types: bool = True) -> Frame:
+             infer_types: bool = True, process_shard: bool = False) -> Frame:
     """Small CSV reader for the tabular paths (the reference leaned on
-    spark.read.csv; this covers the benchmark/AutoML datasets)."""
+    spark.read.csv; this covers the benchmark/AutoML datasets).
+    ``process_shard=True``: keep only this host's contiguous row slice
+    (single-file format — every host parses, then keeps its share)."""
     with open(path, newline="") as f:
         rows = list(_csv.reader(f))
     if not rows:
         raise ValueError(f"empty csv: {path}")
     names = rows[0] if header else [f"c{i}" for i in range(len(rows[0]))]
-    data_rows = rows[1:] if header else rows
+    data_rows = _process_slice(rows[1:] if header else rows, process_shard)
     cols: dict = {n: [] for n in names}
     for r in data_rows:
         for n, v in zip(names, r):

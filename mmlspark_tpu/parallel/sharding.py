@@ -12,6 +12,7 @@ Rules are name-pattern based (à la t5x/flax partitioning): a list of
 from __future__ import annotations
 
 import re
+from functools import lru_cache
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
@@ -136,6 +137,59 @@ def batch_sharding(mesh: Mesh, batch_axes: Sequence[str] = BATCH_AXES,
     return NamedSharding(mesh, P(axes))
 
 
+def mesh_spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh contains devices this process cannot address —
+    the multi-host case where a plain ``device_put`` would raise."""
+    return _spans(mesh)
+
+
+@lru_cache(maxsize=None)
+def _spans(mesh: Mesh) -> bool:
+    pid = jax.process_index()
+    return any(d.process_index != pid for d in mesh.devices.flat)
+
+
+@lru_cache(maxsize=None)
+def batch_share(mesh: Mesh, axes: Optional[Tuple[str, ...]] = None
+                ) -> Tuple[int, int]:
+    """(local, total) batch-dim shard counts for this process.
+
+    ``total`` is how many blocks the batch dimension splits into over the
+    data axes; ``local`` is how many of those blocks have at least one
+    device owned by this process. A process's share of a global batch of
+    ``b`` rows is ``b * local / total`` — THE division of labor for
+    per-host batch assembly (each host feeds only the rows its devices
+    hold, the TPU-native replacement for the reference's shared-filesystem
+    hand-off where every MPI rank re-read the whole dataset).
+    """
+    axes = active_batch_axes(mesh) if axes is None else axes
+    if not axes:
+        return 1, 1
+    names = list(mesh.axis_names)
+    dev = mesh.devices
+    ax_idx = [names.index(a) for a in axes]
+    order = ax_idx + [i for i in range(dev.ndim) if i not in ax_idx]
+    total = int(np.prod([dev.shape[i] for i in ax_idx]))
+    blocks = np.transpose(dev, order).reshape(total, -1)
+    pid = jax.process_index()
+    local = sum(1 for i in range(total)
+                if any(d.process_index == pid for d in blocks[i]))
+    return local, total
+
+
+def local_batch_rows(mesh: Mesh, global_rows: int) -> int:
+    """Rows of a ``global_rows`` batch this process must supply.
+
+    THE one place the division of labor is computed — shard_batch and
+    DeviceEpochCache both defer here, so the share formula cannot drift."""
+    local, total = batch_share(mesh)
+    if global_rows % total:
+        raise ValueError(
+            f"global batch of {global_rows} rows does not split into "
+            f"{total} equal batch shards")
+    return global_rows // total * local
+
+
 def shard_batch(mesh: Mesh, batch: Any,
                 seq_axis: Optional[str] = None) -> Any:
     """Place a host batch onto the mesh, sharded over data axes.
@@ -143,9 +197,27 @@ def shard_batch(mesh: Mesh, batch: Any,
     This is the host->HBM hand-off replacing the reference's shared-filesystem
     data channel (``DataConversion.scala:106-173``): one device_put of a
     contiguous host array per input, no text files, no per-element copies.
+
+    Under a multi-process launch (``mesh_spans_processes``), ``batch`` holds
+    this process's LOCAL rows — ``local_batch_rows(mesh, b)`` of a global
+    batch of ``b`` — and the global array assembles from every process's
+    contribution without any cross-host copy of the data itself (each
+    host's rows land on its own devices; only metadata rendezvous).
+    Global row order is process order: process 0's rows first.
     """
+    spans = mesh_spans_processes(mesh)
+
     def put(x):
         x = np.asarray(x)
         sharding = batch_sharding(mesh, seq_axis=seq_axis if x.ndim > 1 else None)
+        if spans:
+            local, total = batch_share(mesh)
+            if x.shape[0] % local:
+                raise ValueError(
+                    f"local batch of {x.shape[0]} rows does not split into "
+                    f"this process's {local} batch shards (of {total} "
+                    "global)")
+            gshape = (x.shape[0] // local * total,) + x.shape[1:]
+            return jax.make_array_from_process_local_data(sharding, x, gshape)
         return jax.device_put(x, sharding)
     return jax.tree_util.tree_map(put, batch)

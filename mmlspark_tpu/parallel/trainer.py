@@ -29,7 +29,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mmlspark_tpu.parallel.mesh import mesh_from_config
 from mmlspark_tpu.parallel.sharding import (
-    active_batch_axes, batch_sharding, param_shardings, Rules, shard_batch,
+    active_batch_axes, batch_sharding, local_batch_rows,
+    mesh_spans_processes, param_shardings, Rules, shard_batch,
 )
 from mmlspark_tpu.utils import config as mmlconfig
 from mmlspark_tpu.utils.logging import MetricLogger
@@ -162,6 +163,14 @@ class DeviceEpochCache:
     Rows beyond ``steps * batch_size`` are dropped; callers that need the
     tail pad-and-mask FIRST (``_pad_xyw``) and let the pad rows ride along
     with zero weight.
+
+    Multi-process: ``batch_size`` is the GLOBAL batch and ``data`` holds
+    this process's LOCAL rows — its ``batch_share`` of every batch, in
+    process order (process 0's rows sort first within each batch). The
+    epoch assembles into one global jax.Array whose shards live on each
+    host's own devices; the device-side shuffle then permutes GLOBALLY
+    (same fold_in key on every process under SPMD), so batch composition
+    is identical to a single-process cache over the concatenated rows.
     """
 
     def __init__(self, data: Dict[str, np.ndarray], batch_size: int,
@@ -169,29 +178,33 @@ class DeviceEpochCache:
                  shuffle: bool = False, seed: int = 0):
         self.mesh = mesh or mesh_from_config()
         self.batch_size = int(batch_size)
+        self._spans = mesh_spans_processes(self.mesh)
+        self.local_batch = (local_batch_rows(self.mesh, self.batch_size)
+                            if self._spans else self.batch_size)
         first = next(iter(data.values()))
         n = first.shape[0]
-        self.steps_per_epoch = n // self.batch_size
+        self.steps_per_epoch = n // self.local_batch
         if self.steps_per_epoch < 1:
             raise ValueError(
-                f"epoch of {n} rows is smaller than batch_size {batch_size}")
+                f"epoch of {n} local rows is smaller than the local batch "
+                f"{self.local_batch}")
         self.shuffle = shuffle
         self.seed = seed
         self._epoch: Optional[int] = None
 
-        keep = self.steps_per_epoch * self.batch_size
+        keep = self.steps_per_epoch * self.local_batch
         if keep < n:
             import warnings
             warnings.warn(
                 f"DeviceEpochCache drops {n - keep} of {n} rows beyond "
-                f"steps*batch_size ({self.steps_per_epoch}*{self.batch_size});"
+                f"steps*batch_size ({self.steps_per_epoch}*{self.local_batch});"
                 " pad-and-mask the tail first (learners._pad_xyw) to train on"
                 " every row", stacklevel=2)
         with self.mesh:
             def put(name, x):
                 x = np.ascontiguousarray(
                     np.asarray(x)[:keep].reshape(
-                        (self.steps_per_epoch, self.batch_size)
+                        (self.steps_per_epoch, self.local_batch)
                         + np.asarray(x).shape[1:]))
                 axes = active_batch_axes(self.mesh)
                 if (seq_axis and x.ndim > 2
@@ -199,7 +212,13 @@ class DeviceEpochCache:
                     spec = P(None, axes, seq_axis)
                 else:
                     spec = P(None, axes)
-                return jax.device_put(x, NamedSharding(self.mesh, spec))
+                sharding = NamedSharding(self.mesh, spec)
+                if self._spans:
+                    gshape = ((self.steps_per_epoch, self.batch_size)
+                              + x.shape[2:])
+                    return jax.make_array_from_process_local_data(
+                        sharding, x, gshape)
+                return jax.device_put(x, sharding)
 
             base = {k: put(k, v) for k, v in data.items()}
             self._nbytes = sum(int(a.nbytes) for a in base.values())

@@ -149,21 +149,25 @@ class _DeepEstimatorBase(JaxEstimator):
         return {"x": x, "y": y, "w": w}
 
     def _make_device_cache(self, frame: Frame, fcol: str, lcol: str,
-                           bs: int, mesh, mode: str = None):
+                           bs: int, mesh, mode: str = None,
+                           local_batch: int = None, steps: int = None):
         """DeviceEpochCache over the pad-and-masked epoch, or None.
 
         'auto' caches when the padded epoch fits ``runtime.device_cache_mb``
         (see ``DeviceEpochCache.fits`` for the peak-residency accounting);
         'on' forces it; 'off' streams. Construction is shared with the
         built-in learners (``learners._epoch_device_cache``). ``mode``
-        overrides the ``deviceCache`` param (checkpoint-resume pinning)."""
+        overrides the ``deviceCache`` param (checkpoint-resume pinning);
+        ``local_batch``/``steps`` carry the multi-process quota (this
+        process pads its shard to ``steps * local_batch`` rows)."""
         mode = mode if mode is not None else self.get("deviceCache")
         if mode == "off":
             return None
         from mmlspark_tpu.train.learners import _epoch_device_cache
         return _epoch_device_cache(frame, fcol, lcol, bs, self._y_dtype,
                                    mesh=mesh, seed=self.seed,
-                                   force=mode == "on")
+                                   force=mode == "on",
+                                   local_batch=local_batch, steps=steps)
 
     # -- task hooks (subclass responsibility) -------------------------------
     def _n_out(self, frame: Frame, ymax, ymu, ysigma) -> int:
@@ -176,6 +180,40 @@ class _DeepEstimatorBase(JaxEstimator):
                       ymu, ysigma):
         raise NotImplementedError
 
+    # -- multi-process -----------------------------------------------------
+    @staticmethod
+    def _allreduce_moments(moments):
+        """Sum/max the per-process streaming moments so fit-time statistics
+        describe the GLOBAL dataset even though each host scanned only its
+        own Frame shard (the reference's CNTK ranks re-read the whole
+        dataset from the shared filesystem instead).
+
+        Tolerates empty LOCAL shards (n=0, d unknown): a header exchange
+        agrees on the feature width first, then empty hosts contribute
+        zero accumulators — the global-empty case surfaces at the caller's
+        ``moments[0] == 0`` check, and uneven hosts train via the
+        zero-weight filler batches in ``host_batches``."""
+        from jax.experimental import multihost_utils
+        n, d, s, ss, ymax, ysum, ysumsq = moments
+        header = np.asarray([n, -1 if d is None else d], np.float64)
+        h = np.asarray(multihost_utils.process_allgather(header))
+        d_all = int(h[:, 1].max())
+        if d_all < 0:
+            return 0, None, None, None, -1, 0.0, 0.0
+        if d is not None and d != d_all:
+            raise ValueError(
+                f"feature width differs across processes: {d} vs {d_all}")
+        if d is None:
+            s, ss = np.zeros(d_all), np.zeros(d_all)
+        packed = np.concatenate(
+            [np.asarray([n], np.float64), s, ss,
+             np.asarray([ymax, ysum, ysumsq], np.float64)])
+        g = np.asarray(multihost_utils.process_allgather(packed))
+        return (int(g[:, 0].sum()), d_all, g[:, 1:1 + d_all].sum(axis=0),
+                g[:, 1 + d_all:1 + 2 * d_all].sum(axis=0),
+                int(g[:, -3].max()), float(g[:, -2].sum()),
+                float(g[:, -1].sum()))
+
     # -- fit ---------------------------------------------------------------
     def fit(self, frame: Frame):
         from mmlspark_tpu.parallel.trainer import DistributedTrainer
@@ -184,13 +222,24 @@ class _DeepEstimatorBase(JaxEstimator):
         mesh = _resolve_mesh(self.get("meshSpec"))
 
         # Batch must split evenly over the data axes and accum microbatches.
-        from mmlspark_tpu.parallel.sharding import active_batch_axes
+        from mmlspark_tpu.parallel.sharding import (
+            active_batch_axes, local_batch_rows, mesh_spans_processes,
+        )
         axes = active_batch_axes(mesh) or ()
         dp = int(np.prod([mesh.shape[a] for a in axes])) if axes else 1
         quantum = dp * self.accumSteps
         bs = int(math.ceil(self.batchSize / quantum) * quantum)
+        spans = mesh_spans_processes(mesh)
+        # each process feeds only the rows its devices hold (its
+        # batch_share of every global batch); single-process: the whole bs
+        local_bs = local_batch_rows(mesh, bs) if spans else bs
 
-        n, d, mu, sigma, ymax, ymu, ysigma = self._streaming_stats(frame)
+        moments = self._streaming_moments(frame)
+        if spans:
+            moments = self._allreduce_moments(moments)
+        if moments[0] == 0:
+            raise ValueError(f"{type(self).__name__}: empty frame")
+        n, d, mu, sigma, ymax, ymu, ysigma = self._finalize_stats(*moments)
         n_out = self._n_out(frame, ymax, ymu, ysigma)
 
         spec, resolved_args = _build_spec(
@@ -227,6 +276,13 @@ class _DeepEstimatorBase(JaxEstimator):
             state, resumed = trainer.init(init_params_fn), False
 
         steps_per_epoch = math.ceil(n / bs)
+        if spans and math.ceil(frame.count() / local_bs) > steps_per_epoch:
+            raise ValueError(
+                f"process {jax.process_index()} holds {frame.count()} rows "
+                f"but its per-epoch quota is {steps_per_epoch * local_bs} "
+                f"({steps_per_epoch} steps x {local_bs} local rows); "
+                "rebalance the per-host shards (Frame.process_shard splits "
+                "evenly)")
         total_steps = steps_per_epoch * self.epochs
         # Elastic resume: whole epochs already trained are skipped
         # arithmetically; only the partial epoch streams batches past.
@@ -252,22 +308,35 @@ class _DeepEstimatorBase(JaxEstimator):
                 elif recorded == "cached":
                     mode = "on"
             cache = self._make_device_cache(frame, fcol, lcol, bs, mesh,
-                                            mode=mode)
+                                            mode=mode, local_batch=local_bs,
+                                            steps=steps_per_epoch)
             if ckpt is not None:
                 ckpt.put_meta(
                     batch_order="cached" if cache is not None else "streamed")
 
         def host_batches():
-            """Padded fixed-shape batches, shuffled per epoch. The epoch's
-            permutation is seeded by (seed, epoch) so an elastic resume
-            replays the SAME order and the arithmetic skip stays aligned."""
+            """Padded fixed-shape LOCAL batches, shuffled per epoch. The
+            permutation is seeded by (seed, epoch[, process]) so an elastic
+            resume replays the SAME order and the arithmetic skip stays
+            aligned. Multi-process: each host shuffles only its own shard
+            and, when shards are uneven, pads with zero-weight batches so
+            every process dispatches the same number of steps (the global
+            batch still carries real rows from the fuller shards)."""
             for epoch in range(start_epoch, self.epochs):
-                epoch_rng = np.random.default_rng([seed, epoch])
-                for j, hb in enumerate(frame.shuffled_batches(
-                        bs, cols=[fcol, lcol], rng=epoch_rng)):
-                    if epoch == start_epoch and j < skip_in_epoch:
-                        continue
-                    yield self._pad_batch(hb, fcol, lcol, bs)
+                epoch_rng = np.random.default_rng(
+                    [seed, epoch] + ([jax.process_index()] if spans else []))
+                j = 0
+                for hb in frame.shuffled_batches(
+                        local_bs, cols=[fcol, lcol], rng=epoch_rng):
+                    if not (epoch == start_epoch and j < skip_in_epoch):
+                        yield self._pad_batch(hb, fcol, lcol, local_bs)
+                    j += 1
+                while j < steps_per_epoch:  # lockstep filler (uneven shards)
+                    if not (epoch == start_epoch and j < skip_in_epoch):
+                        yield {"x": np.zeros((local_bs, d), np.float32),
+                               "y": np.zeros((local_bs,), self._y_dtype),
+                               "w": np.zeros((local_bs,), np.float32)}
+                    j += 1
 
         def cached_batches():
             """Same epoch/skip arithmetic as host_batches, but every batch
@@ -305,12 +374,23 @@ class _DeepEstimatorBase(JaxEstimator):
             ckpt.save(state, step=step, wait=True)
         if last_loss is None:
             # fully-resumed fit (no step ran): evaluate the restored params
-            hb = next(iter(frame.batches(bs, cols=[fcol, lcol])))
+            hb = next(iter(frame.batches(local_bs, cols=[fcol, lcol])))
             last_loss = trainer.eval_step(
-                state, trainer.put_batch(self._pad_batch(hb, fcol, lcol, bs)),
+                state,
+                trainer.put_batch(self._pad_batch(hb, fcol, lcol, local_bs)),
                 rng)
 
-        params_host = jax.device_get(state["params"])
+        params = state["params"]
+        if spans:
+            # gather fsdp-sharded params into fully-replicated arrays so
+            # every process can fetch the fitted model without touching
+            # non-addressable shards
+            from jax.sharding import NamedSharding, PartitionSpec
+            with mesh:
+                params = jax.jit(
+                    lambda p: p,
+                    out_shardings=NamedSharding(mesh, PartitionSpec()))(params)
+        params_host = jax.device_get(params)
         from mmlspark_tpu.models.jax_model import _to_plain
         state_arrays = {
             "params": _to_plain(params_host),

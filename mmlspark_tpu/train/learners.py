@@ -92,15 +92,17 @@ class JaxEstimator(HasFeaturesCol, HasLabelCol, Estimator):
             raise ValueError(f"{type(self).__name__}: empty frame")
         return ymax
 
-    def _streaming_stats(self, frame: Frame):
-        """One streaming pass over (features, label):
-        (n, d, mu, sigma, ymax, ymu, ysigma)."""
+    def _streaming_moments(self, frame: Frame):
+        """One streaming pass over (features, label): the RAW accumulators
+        ``(n, d, s, ss, ymax, ysum, ysumsq)`` — additive across data
+        shards, so a multi-process fit can allreduce them before
+        ``_finalize_stats`` (each host scans only its own rows)."""
         fcol, lcol = self.featuresCol, self.labelCol
         bs = self.get("batchSize") if any(
             p.name == "batchSize" for p in self.params()) else 1 << 16
         n, d = 0, None
         s = ss = None
-        ymax, ysum, ysumsq = 0, 0.0, 0.0
+        ymax, ysum, ysumsq = -1, 0.0, 0.0
         for hb in frame.batches(bs, cols=[fcol, lcol]):
             x = np.asarray(hb[fcol], dtype=np.float64)
             if x.ndim != 2:
@@ -117,14 +119,27 @@ class JaxEstimator(HasFeaturesCol, HasLabelCol, Estimator):
                 ymax = max(ymax, int(y.max()))
                 ysum += y.sum()
                 ysumsq += (y * y).sum()
+        return n, d, s, ss, ymax, ysum, ysumsq
+
+    @staticmethod
+    def _finalize_stats(n, d, s, ss, ymax, ysum, ysumsq):
+        """Moments -> (n, d, mu, sigma, ymax, ymu, ysigma)."""
         if n == 0:
-            raise ValueError(f"{type(self).__name__}: empty frame")
+            raise ValueError("empty frame")
         mu = (s / n).astype(np.float32)
         sigma = (np.sqrt(np.maximum(ss / n - (s / n) ** 2, 0.0)) + 1e-6
                  ).astype(np.float32)
         ymu = ysum / n
         ysigma = float(np.sqrt(max(ysumsq / n - ymu * ymu, 0.0))) + 1e-6
-        return n, d, mu, sigma, ymax, float(ymu), ysigma
+        return n, d, mu, sigma, max(int(ymax), 0), float(ymu), ysigma
+
+    def _streaming_stats(self, frame: Frame):
+        """One streaming pass over (features, label):
+        (n, d, mu, sigma, ymax, ymu, ysigma)."""
+        moments = self._streaming_moments(frame)
+        if moments[0] == 0:
+            raise ValueError(f"{type(self).__name__}: empty frame")
+        return self._finalize_stats(*moments)
 
     def _num_classes(self, frame: Frame, y) -> int:
         """Class count from the label column's level metadata when present —
@@ -155,7 +170,8 @@ def _pad_xyw(hb: Dict[str, np.ndarray], fcol: str, lcol: str, bs: int,
 
 def _epoch_device_cache(frame: Frame, fcol: str, lcol: str, batch_size: int,
                         y_dtype, mesh=None, seed: int = 0,
-                        force: bool = False):
+                        force: bool = False, local_batch: int = None,
+                        steps: int = None):
     """Pad-and-masked epoch -> shuffled DeviceEpochCache, or None when it
     exceeds the ``runtime.device_cache_mb`` budget (unless ``force``).
 
@@ -166,14 +182,26 @@ def _epoch_device_cache(frame: Frame, fcol: str, lcol: str, batch_size: int,
     ride through every shuffled epoch masked out of the loss. Single-batch
     epochs skip the shuffle: batch composition is invariant under
     permutation and the per-epoch gather isn't free.
+
+    Multi-process: ``batch_size`` stays the GLOBAL batch while
+    ``local_batch``/``steps`` set this process's quota — its shard pads to
+    ``steps * local_batch`` rows and the cache assembles the global epoch
+    from every host's contribution (``DeviceEpochCache`` multi-process
+    contract).
     """
     from mmlspark_tpu.parallel.trainer import DeviceEpochCache
+    local_batch = batch_size if local_batch is None else local_batch
     n = frame.count()
     if n == 0:
         raise ValueError("empty frame")
     d = np.asarray(frame.head(1)[0][fcol]).size
-    padded = int(np.ceil(n / batch_size) * batch_size)
-    shuffle = padded > batch_size
+    steps = int(np.ceil(n / local_batch)) if steps is None else steps
+    padded = steps * local_batch
+    if n > padded:
+        raise ValueError(
+            f"shard of {n} rows exceeds its epoch quota {padded} "
+            f"({steps} steps x {local_batch} local rows)")
+    shuffle = steps > 1
     stand_in = {
         "x": np.broadcast_to(np.float32(0), (padded, d)),
         "y": np.broadcast_to(np.zeros((), y_dtype), (padded,)),
