@@ -177,6 +177,49 @@ def load_stage(path: str):
     return stage
 
 
+def stage_fingerprint(stage) -> str:
+    """Content hash of a stage: class + explicit params + state, nested
+    stages included, uids EXCLUDED — two stages fit identically (same
+    config, same data) fingerprint the same even though their uids differ.
+    FindBestModel uses this to share one featurize pass across candidates
+    whose featurization is semantically identical."""
+    import hashlib
+    h = hashlib.sha256()
+
+    def feed(o):
+        if _is_stage(o):
+            h.update(b"\x01")
+            h.update(f"{type(o).__module__}.{type(o).__name__}".encode())
+            for k, v in sorted(o.explicit_param_values().items()):
+                h.update(k.encode())
+                feed(v)
+            h.update(b"\x02")
+            feed(o._get_state())
+        elif isinstance(o, dict):
+            h.update(b"\x03")
+            for k in sorted(o, key=str):
+                if str(k) in ("uid", "model_uid"):
+                    continue  # identity, not content
+                h.update(str(k).encode())
+                feed(o[k])
+        elif isinstance(o, (list, tuple)):
+            h.update(b"\x04")
+            for v in o:
+                feed(v)
+        elif isinstance(o, np.ndarray):
+            h.update(b"\x05")
+            h.update(str(o.dtype).encode())
+            h.update(str(o.shape).encode())
+            h.update(o.tobytes() if o.dtype != np.object_
+                     else repr(o.tolist()).encode())
+        else:
+            h.update(b"\x06")
+            h.update(repr(o).encode())
+
+    feed(stage)
+    return h.hexdigest()
+
+
 def _json_fallback(o):
     if isinstance(o, (np.integer,)):
         return int(o)

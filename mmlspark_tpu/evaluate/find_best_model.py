@@ -45,10 +45,30 @@ class FindBestModel(Estimator):
         if not lower and metric not in HIGHER_IS_BETTER:
             raise ValueError(f"unknown metric {metric!r}")
 
+        # Featurize ONCE per distinct featurization: candidates whose
+        # featurizeModel fingerprints identically (typical when several
+        # learners were trained by TrainClassifier on the same data) share
+        # a single featurize pass, so N-candidate selection costs ~one
+        # pass over the data plus N cheap scoring heads — the reference
+        # re-ran the whole pipeline per candidate
+        # (``FindBestModel.scala:135-143``).
+        from mmlspark_tpu.core.serialization import stage_fingerprint
+        featurized_cache: dict = {}
+
+        def score(cand):
+            featurizer = (cand.get("featurizeModel", None)
+                          if hasattr(cand, "transform_featurized") else None)
+            if featurizer is None:
+                return cand.transform(frame)
+            fp = stage_fingerprint(featurizer)
+            if fp not in featurized_cache:
+                featurized_cache[fp] = featurizer.transform(frame)
+            return cand.transform_featurized(featurized_cache[fp])
+
         rows = []
         best = None  # (value, model, scored, roc)
         for cand in candidates:
-            scored = cand.transform(frame)
+            scored = score(cand)
             ev = ComputeModelStatistics()
             all_metrics = {k: v[0] for k, v in ev.transform(scored).collect().items()}
             if metric not in all_metrics:

@@ -134,15 +134,27 @@ class Word2Vec(HasInputCol, HasOutputCol, Estimator):
         neg = self.numNegatives
         lr = self.stepSize
 
-        def epoch(params, c_all, x_all, key):
+        n_pairs = int(centers.size)
+
+        def epoch(params, c_all, x_all, table_d, key):
             w_in, w_out = params
+            # Device-side per-epoch shuffle + wrap-pad: the pair arrays
+            # transfer host->HBM ONCE before the first epoch, and every
+            # later epoch is pure on-device gather — the same residency
+            # contract as DeviceEpochCache (a host permutation here would
+            # re-ship the whole epoch every iteration).
+            key, kp = jax.random.split(key)
+            perm = jax.random.permutation(kp, n_pairs)
+            idx = jnp.take(perm, jnp.arange(padded) % n_pairs)
+            c_all = jnp.take(c_all, idx)
+            x_all = jnp.take(x_all, idx)
 
             def step(carry, cb_xb):
                 w_in, w_out, key = carry
                 cb, xb = cb_xb
                 key, k1 = jax.random.split(key)
                 neg_idx = jnp.take(
-                    jnp.asarray(table),
+                    table_d,
                     jax.random.randint(k1, (batch, neg), 0, _TABLE_SIZE), axis=0)
 
                 def loss_fn(w_in, w_out):
@@ -160,8 +172,8 @@ class Word2Vec(HasInputCol, HasOutputCol, Estimator):
                     w_in, w_out)
                 return (w_in - lr * grads[0], w_out - lr * grads[1], key), loss
 
-            cb = c_all[:n_batches * batch].reshape(n_batches, batch)
-            xb = x_all[:n_batches * batch].reshape(n_batches, batch)
+            cb = c_all.reshape(n_batches, batch)
+            xb = x_all.reshape(n_batches, batch)
             (w_in, w_out, _), losses = jax.lax.scan(
                 step, (w_in, w_out, key), (cb, xb))
             return (w_in, w_out), losses.mean()
@@ -173,13 +185,13 @@ class Word2Vec(HasInputCol, HasOutputCol, Estimator):
         w_out = jnp.zeros((v, dim), jnp.float32)
         params = (w_in, w_out)
         padded = n_batches * batch
+        # ONE transfer each for the pair stream and the negative table;
+        # epochs re-permute on device (see epoch() above)
+        c_dev, x_dev = jnp.asarray(centers), jnp.asarray(contexts)
+        table_dev = jnp.asarray(table)
         for it in range(self.maxIter):
             key, sub = jax.random.split(key)
-            perm = host_rng.permutation(centers.size)
-            params, _ = epoch_jit(params,
-                                  jnp.asarray(np.resize(centers[perm], padded)),
-                                  jnp.asarray(np.resize(contexts[perm], padded)),
-                                  sub)
+            params, _ = epoch_jit(params, c_dev, x_dev, table_dev, sub)
         return self._make_model(vocab, np.asarray(params[0]))
 
     def _make_model(self, vocab: List[str], vectors: np.ndarray) -> "Word2VecModel":

@@ -73,10 +73,14 @@ def _resolve_mesh(mesh_spec):
 
 
 def _build_spec(architecture: str, arch_args: Dict[str, Any],
-                input_dim: int, n_classes: int) -> Tuple[Dict[str, Any], Dict[str, Any]]:
-    """Build the zoo spec, injecting input_dim/num_classes where the builder
-    accepts them and the caller didn't pin them. Returns (spec, resolved_args)
-    so the fitted model can rebuild the exact same architecture."""
+                input_dim: int, n_classes: int,
+                train_dtype: str = "") -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Build the zoo spec, injecting input_dim/num_classes (and the compute
+    dtype, when ``train_dtype`` is set) where the builder accepts them and
+    the caller didn't pin them. Returns (spec, resolved_args) so the fitted
+    model can rebuild the exact same architecture. Dtypes pass as STRINGS
+    ('bfloat16') — flax accepts them and they stay JSON-serializable in
+    ``architectureArgs``."""
     from mmlspark_tpu.models.zoo import _ZOO, build_model
     args = dict(arch_args or {})
     builder = _ZOO.get(architecture)
@@ -90,7 +94,38 @@ def _build_spec(architecture: str, arch_args: Dict[str, Any],
         args.setdefault("input_dim", int(input_dim))
     if "num_classes" in accepted:
         args.setdefault("num_classes", int(n_classes))
+    if train_dtype and "dtype" in accepted:
+        args.setdefault("dtype", train_dtype)
     return build_model(architecture, **args), args
+
+
+def _train_val_split(frame: Frame, frac: float, seed: int
+                     ) -> Tuple[Frame, Frame]:
+    """Deterministic PER-PARTITION row split (seeded; both sides keep their
+    original row order and partitioning). Splitting partition-by-partition
+    keeps peak memory at O(one partition) — no global collect — at the
+    price of the split depending on the partition layout. Multi-process
+    fits split each host's LOCAL shard; validation metrics then aggregate
+    globally through the sharded eval."""
+    if not frame.schema.names:
+        raise ValueError("cannot split an empty-schema frame")
+    rng = np.random.default_rng([seed, 715])
+    first = frame.schema.names[0]
+    tr_parts, va_parts = [], []
+    for p in frame.partitions:
+        n = len(p[first])
+        k = int(round(n * frac))
+        perm = rng.permutation(n)
+        va, tr = np.sort(perm[:k]), np.sort(perm[k:])
+        tr_parts.append({name: p[name][tr] for name in frame.schema.names})
+        va_parts.append({name: p[name][va] for name in frame.schema.names})
+    train = Frame(frame.schema, tr_parts)
+    val = Frame(frame.schema, va_parts)
+    if val.count() < 1 or train.count() < 1:
+        raise ValueError(
+            f"validationSplit={frac} leaves an empty split of "
+            f"{frame.count()} rows")
+    return train, val
 
 
 class _DeepEstimatorBase(JaxEstimator):
@@ -113,8 +148,30 @@ class _DeepEstimatorBase(JaxEstimator):
                          validator=lambda v: v > 0)
     epochs = IntParam("epochs", "training epochs over the frame", 5,
                       validator=lambda v: v > 0)
-    learningRate = FloatParam("learningRate", "AdamW learning rate", 1e-3)
-    weightDecay = FloatParam("weightDecay", "AdamW weight decay", 1e-4)
+    learningRate = FloatParam("learningRate", "peak learning rate", 1e-3)
+    weightDecay = FloatParam("weightDecay", "weight decay (adamw/lamb)", 1e-4)
+    optimizer = StringParam(
+        "optimizer", "optimizer family", "adamw",
+        domain=("adamw", "adam", "sgd", "lamb", "adafactor"))
+    lrSchedule = StringParam(
+        "lrSchedule", "learning-rate schedule over the whole fit: "
+        "'constant', 'cosine' (decay to 0), 'linear' (decay to 0); all "
+        "start with warmupSteps of linear warmup", "constant",
+        domain=("constant", "cosine", "linear"))
+    warmupSteps = IntParam("warmupSteps", "linear LR warmup steps", 0,
+                           validator=lambda v: v >= 0)
+    trainDtype = StringParam(
+        "trainDtype", "compute dtype for architectures that accept one "
+        "('' = architecture default, typically bfloat16 — the MXU-native "
+        "choice)", "", domain=("", "bfloat16", "float32"))
+    validationSplit = FloatParam(
+        "validationSplit", "fraction of rows held out for per-epoch "
+        "validation metrics (0 = off)", 0.0,
+        validator=lambda v: 0.0 <= v < 1.0)
+    earlyStoppingPatience = IntParam(
+        "earlyStoppingPatience", "stop after N epochs without val-loss "
+        "improvement (0 = off; requires validationSplit > 0)", 0,
+        validator=lambda v: v >= 0)
     accumSteps = IntParam(
         "accumSteps", "gradient-accumulation microbatches per step", 1,
         validator=lambda v: v >= 1)
@@ -169,8 +226,48 @@ class _DeepEstimatorBase(JaxEstimator):
                                    force=mode == "on",
                                    local_batch=local_batch, steps=steps)
 
+    # -- optimizer / schedule ----------------------------------------------
+    def _build_optimizer(self, total_steps: int):
+        """optax transform from the optimizer/lrSchedule/warmupSteps params.
+
+        The schedule reads the optimizer step count, which checkpoints
+        restore — an elastic resume continues the schedule where it left
+        off (CNTKLearner exposed the full BrainScript training config,
+        ``CNTKLearner.scala:16-43``; this is the in-process equivalent)."""
+        lr, warm = float(self.learningRate), int(self.warmupSteps)
+        sched_name = self.get("lrSchedule")
+        total = max(int(total_steps), warm + 1)
+        if sched_name == "cosine":
+            sched = optax.warmup_cosine_decay_schedule(
+                0.0 if warm else lr, lr, warm, total, end_value=0.0)
+        elif sched_name == "linear":
+            sched = optax.join_schedules(
+                [optax.linear_schedule(0.0, lr, max(warm, 1)),
+                 optax.linear_schedule(lr, 0.0, total - warm)], [warm])
+        elif warm:
+            sched = optax.join_schedules(
+                [optax.linear_schedule(0.0, lr, warm),
+                 optax.constant_schedule(lr)], [warm])
+        else:
+            sched = lr
+        wd = float(self.weightDecay)
+        name = self.get("optimizer")
+        return {
+            "adamw": lambda: optax.adamw(sched, weight_decay=wd),
+            "adam": lambda: optax.adam(sched),
+            "sgd": lambda: optax.sgd(sched, momentum=0.9),
+            "lamb": lambda: optax.lamb(sched, weight_decay=wd),
+            "adafactor": lambda: optax.adafactor(sched),
+        }[name]()
+
     # -- task hooks (subclass responsibility) -------------------------------
     def _n_out(self, frame: Frame, ymax, ymu, ysigma) -> int:
+        raise NotImplementedError
+
+    def _make_val_step(self, module, prep, ymu, ysigma):
+        """(jitted f(params, batch) -> stacked sums, finalize(sums) -> dict
+        with at least 'val_loss'). Weighted sums, so zero-weight pad rows
+        (and multi-process filler batches) drop out of the metrics."""
         raise NotImplementedError
 
     def _make_loss(self, module, prep, ymu, ysigma):
@@ -219,6 +316,10 @@ class _DeepEstimatorBase(JaxEstimator):
         from mmlspark_tpu.parallel.trainer import DistributedTrainer
 
         fcol, lcol = self.featuresCol, self.labelCol
+        # per-epoch validation history, readable after fit() on BOTH the
+        # estimator and the fitted model (TrainClassifier fits a COPY of
+        # the learner, so the model is the reliable handle)
+        self.validation_history = []
         mesh = _resolve_mesh(self.get("meshSpec"))
 
         # Batch must split evenly over the data axes and accum microbatches.
@@ -234,6 +335,16 @@ class _DeepEstimatorBase(JaxEstimator):
         # batch_share of every global batch); single-process: the whole bs
         local_bs = local_batch_rows(mesh, bs) if spans else bs
 
+        seed = self.seed
+        patience = int(self.get("earlyStoppingPatience"))
+        val_frac = float(self.get("validationSplit"))
+        if patience and not val_frac:
+            raise ValueError(
+                "earlyStoppingPatience requires validationSplit > 0")
+        val_frame = None
+        if val_frac:
+            frame, val_frame = _train_val_split(frame, val_frac, seed)
+
         moments = self._streaming_moments(frame)
         if spans:
             moments = self._allreduce_moments(moments)
@@ -243,7 +354,8 @@ class _DeepEstimatorBase(JaxEstimator):
         n_out = self._n_out(frame, ymax, ymu, ysigma)
 
         spec, resolved_args = _build_spec(
-            self.architecture, self.get("architectureArgs"), d, n_out)
+            self.architecture, self.get("architectureArgs"), d, n_out,
+            train_dtype=self.get("trainDtype"))
         module = spec["module"]
         in_shape = tuple(spec["input_shape"])
         standardize = self.standardize
@@ -258,12 +370,20 @@ class _DeepEstimatorBase(JaxEstimator):
 
         loss_fn = self._make_loss(module, prep, ymu, ysigma)
 
+        steps_per_epoch = math.ceil(n / bs)
+        if spans and math.ceil(frame.count() / local_bs) > steps_per_epoch:
+            raise ValueError(
+                f"process {jax.process_index()} holds {frame.count()} rows "
+                f"but its per-epoch quota is {steps_per_epoch * local_bs} "
+                f"({steps_per_epoch} steps x {local_bs} local rows); "
+                "rebalance the per-host shards (Frame.process_shard splits "
+                "evenly)")
+        total_steps = steps_per_epoch * self.epochs
+
         trainer = DistributedTrainer(
-            loss_fn, optax.adamw(self.learningRate,
-                                 weight_decay=self.weightDecay),
+            loss_fn, self._build_optimizer(total_steps),
             mesh=mesh, accum_steps=self.accumSteps, remat=self.remat)
 
-        seed = self.seed
         init_params_fn = lambda: module.init(jax.random.PRNGKey(seed),
                                              prep(jnp.zeros((1, d))))
 
@@ -274,16 +394,6 @@ class _DeepEstimatorBase(JaxEstimator):
             state, resumed = ckpt.restore_or_init(trainer, init_params_fn)
         else:
             state, resumed = trainer.init(init_params_fn), False
-
-        steps_per_epoch = math.ceil(n / bs)
-        if spans and math.ceil(frame.count() / local_bs) > steps_per_epoch:
-            raise ValueError(
-                f"process {jax.process_index()} holds {frame.count()} rows "
-                f"but its per-epoch quota is {steps_per_epoch * local_bs} "
-                f"({steps_per_epoch} steps x {local_bs} local rows); "
-                "rebalance the per-host shards (Frame.process_shard splits "
-                "evenly)")
-        total_steps = steps_per_epoch * self.epochs
         # Elastic resume: whole epochs already trained are skipped
         # arithmetically; only the partial epoch streams batches past.
         done = min(int(jax.device_get(state["step"])), total_steps)
@@ -314,62 +424,129 @@ class _DeepEstimatorBase(JaxEstimator):
                 ckpt.put_meta(
                     batch_order="cached" if cache is not None else "streamed")
 
-        def host_batches():
-            """Padded fixed-shape LOCAL batches, shuffled per epoch. The
+        def host_batches(epoch):
+            """Padded fixed-shape LOCAL batches of one epoch, shuffled. The
             permutation is seeded by (seed, epoch[, process]) so an elastic
             resume replays the SAME order and the arithmetic skip stays
             aligned. Multi-process: each host shuffles only its own shard
             and, when shards are uneven, pads with zero-weight batches so
             every process dispatches the same number of steps (the global
             batch still carries real rows from the fuller shards)."""
-            for epoch in range(start_epoch, self.epochs):
-                epoch_rng = np.random.default_rng(
-                    [seed, epoch] + ([jax.process_index()] if spans else []))
-                j = 0
-                for hb in frame.shuffled_batches(
-                        local_bs, cols=[fcol, lcol], rng=epoch_rng):
-                    if not (epoch == start_epoch and j < skip_in_epoch):
-                        yield self._pad_batch(hb, fcol, lcol, local_bs)
-                    j += 1
-                while j < steps_per_epoch:  # lockstep filler (uneven shards)
-                    if not (epoch == start_epoch and j < skip_in_epoch):
-                        yield {"x": np.zeros((local_bs, d), np.float32),
-                               "y": np.zeros((local_bs,), self._y_dtype),
-                               "w": np.zeros((local_bs,), np.float32)}
-                    j += 1
+            epoch_rng = np.random.default_rng(
+                [seed, epoch] + ([jax.process_index()] if spans else []))
+            j = 0
+            for hb in frame.shuffled_batches(
+                    local_bs, cols=[fcol, lcol], rng=epoch_rng):
+                if not (epoch == start_epoch and j < skip_in_epoch):
+                    yield self._pad_batch(hb, fcol, lcol, local_bs)
+                j += 1
+            while j < steps_per_epoch:  # lockstep filler (uneven shards)
+                if not (epoch == start_epoch and j < skip_in_epoch):
+                    yield {"x": np.zeros((local_bs, d), np.float32),
+                           "y": np.zeros((local_bs,), self._y_dtype),
+                           "w": np.zeros((local_bs,), np.float32)}
+                j += 1
 
-        def cached_batches():
+        def cached_batches(epoch):
             """Same epoch/skip arithmetic as host_batches, but every batch
             is an on-device slice of the resident epoch — zero steady-state
             host->HBM transfer. The device-side shuffle is seeded per epoch,
             so resume replays the same order WITHIN this mode (the two modes
-            draw different permutations; each is deterministic)."""
-            for epoch in range(start_epoch, self.epochs):
-                for j, b in enumerate(cache.batches(epoch)):
-                    if epoch == start_epoch and j < skip_in_epoch:
-                        continue
-                    yield b
+            draw different permutations; each is deterministic, and a
+            checkpoint resume pins the mode via the sidecar)."""
+            for j, b in enumerate(cache.batches(epoch)):
+                if epoch == start_epoch and j < skip_in_epoch:
+                    continue
+                yield b
 
         from mmlspark_tpu.parallel.trainer import DevicePrefetcher
         from mmlspark_tpu.utils.logging import MetricLogger
         from mmlspark_tpu.utils.profiling import trace
         metric_log = MetricLogger(every=self.logEvery,
                                   name=type(self).__name__)
-        prefetcher = (cached_batches() if cache is not None else
-                      DevicePrefetcher(host_batches(), trainer.put_batch))
-        try:
-            with trace():  # captures a jax trace iff profiling.trace_dir set
-                for batch in prefetcher:
-                    state, metrics = trainer.train_step(state, batch, rng)
-                    last_loss = metrics["loss"]  # device scalar; no step sync
-                    step += 1
-                    metric_log(step, {"loss": last_loss}, batch_rows=bs)
+
+        # Validation residency: the held-out split pads once and lives on
+        # device for the whole fit — per-epoch evaluation is pure compute.
+        val_fn = finalize = None
+        val_dev = []
+        if val_frame is not None and done < total_steps:
+            val_fn, finalize = self._make_val_step(module, prep, ymu, ysigma)
+            with mesh:
+                val_dev = [
+                    trainer.put_batch(self._pad_batch(hb, fcol, lcol,
+                                                      local_bs))
+                    for hb in val_frame.batches(local_bs, cols=[fcol, lcol])]
+            val_steps = len(val_dev)
+            if spans:
+                # every process must dispatch the same number of eval
+                # programs; uneven val shards pad with zero-weight batches
+                from jax.experimental import multihost_utils
+                counts = np.asarray(multihost_utils.process_allgather(
+                    np.asarray([val_steps], np.int64)))
+                val_steps = int(counts.max())
+                zero = {"x": np.zeros((local_bs, d), np.float32),
+                        "y": np.zeros((local_bs,), self._y_dtype),
+                        "w": np.zeros((local_bs,), np.float32)}
+                with mesh:
+                    val_dev += [trainer.put_batch(zero)
+                                for _ in range(val_steps - len(val_dev))]
+            val_log = MetricLogger(every=1, name=type(self).__name__ + ".val")
+
+        best_val, stale, stopped = float("inf"), 0, False
+        if ckpt is not None and resumed:
+            # early-stopping state rides the checkpoint sidecar so an
+            # elastic restart neither re-trains past a recorded stop nor
+            # resets the patience counter
+            es = ckpt.get_meta().get("early_stop") or {}
+            best_val = float(es.get("best_val", best_val))
+            stale = int(es.get("stale", stale))
+            stopped = bool(es.get("stopped", False))
+            self.validation_history = list(es.get("history", []))
+        with trace():  # captures a jax trace iff profiling.trace_dir set
+            for epoch in range(start_epoch, self.epochs if not stopped
+                               else start_epoch):
+                if cache is not None:
+                    it, closer = cached_batches(epoch), None
+                else:
+                    it = closer = DevicePrefetcher(host_batches(epoch),
+                                                   trainer.put_batch)
+                try:
+                    for batch in it:
+                        state, metrics = trainer.train_step(state, batch, rng)
+                        last_loss = metrics["loss"]  # device scalar
+                        step += 1
+                        metric_log(step, {"loss": last_loss}, batch_rows=bs)
+                        if ckpt is not None:
+                            ckpt.maybe_save(state, every=self.checkpointEvery,
+                                            step=step)
+                finally:
+                    if closer is not None:
+                        closer.close()  # stops producer on early exit
+                if val_fn is not None:
+                    sums_dev = None
+                    with mesh:
+                        # accumulate the tiny metric vector ON device —
+                        # one host round trip per epoch, not per batch
+                        for b in val_dev:
+                            out = val_fn(state["params"], b)
+                            sums_dev = out if sums_dev is None \
+                                else sums_dev + out
+                    vm = finalize(np.asarray(jax.device_get(sums_dev)))
+                    val_log(epoch + 1, vm)
+                    self.validation_history.append(
+                        {"epoch": epoch + 1, **vm})
+                    if vm["val_loss"] < best_val - 1e-12:
+                        best_val, stale = vm["val_loss"], 0
+                    else:
+                        stale += 1
+                        stopped = bool(patience and stale >= patience)
                     if ckpt is not None:
-                        ckpt.maybe_save(state, every=self.checkpointEvery,
-                                        step=step)
-        finally:
-            if isinstance(prefetcher, DevicePrefetcher):
-                prefetcher.close()  # stops the producer on early exit
+                        ckpt.put_meta(early_stop={
+                            "best_val": best_val, "stale": stale,
+                            "stopped": stopped,
+                            "history": self.validation_history})
+                    if stopped:
+                        break
         if ckpt is not None:
             ckpt.save(state, step=step, wait=True)
         if last_loss is None:
@@ -397,6 +574,9 @@ class _DeepEstimatorBase(JaxEstimator):
             "mu": mu, "sigma": sigma,
             "standardize": np.asarray(standardize),
             "final_loss": np.asarray(float(jax.device_get(last_loss))),
+            # plain list-of-dicts: JSON side of the state, survives
+            # save_stage/load_stage (models expose it as a property)
+            "validation_history": list(self.validation_history),
         }
         return self._build_fitted(fcol, lcol, resolved_args, state_arrays,
                                   n_out, ymu, ysigma)
@@ -418,6 +598,24 @@ class DeepClassifier(_DeepEstimatorBase):
             return (ce * w).sum() / jnp.maximum(w.sum(), 1.0)
         return loss_fn
 
+    def _make_val_step(self, module, prep, ymu, ysigma):
+        @jax.jit
+        def f(params, batch):
+            logits = module.apply(params, prep(batch["x"])).astype(
+                jnp.float32)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["y"])
+            w = batch["w"]
+            hit = (jnp.argmax(logits, axis=-1) == batch["y"]).astype(
+                jnp.float32)
+            return jnp.stack([(ce * w).sum(), (hit * w).sum(), w.sum()])
+
+        def finalize(sums):
+            denom = max(float(sums[2]), 1.0)
+            return {"val_loss": float(sums[0]) / denom,
+                    "val_accuracy": float(sums[1]) / denom}
+        return f, finalize
+
     def _build_fitted(self, fcol, lcol, resolved_args, state_arrays, n_out,
                       ymu, ysigma):
         model = DeepClassifierModel(featuresCol=fcol, labelCol=lcol)
@@ -426,6 +624,15 @@ class DeepClassifier(_DeepEstimatorBase):
         model._state = {**state_arrays, "n_classes": np.asarray(int(n_out))}
         return model
 
+
+
+class _HasValidationHistory:
+    """Mixin: per-epoch validation metrics recorded at fit time, surviving
+    save/load (stored on the JSON side of the model state)."""
+
+    @property
+    def validation_history(self):
+        return list(self._get_state().get("validation_history", []))
 
 
 def _scoring_prep(model):
@@ -450,7 +657,8 @@ def _scoring_prep(model):
 
 
 @register_stage
-class DeepClassifierModel(HasFeaturesCol, HasLabelCol, Model):
+class DeepClassifierModel(HasFeaturesCol, HasLabelCol,
+                          _HasValidationHistory, Model):
     """Fitted deep classifier: streams minibatches through the jitted net.
 
     The scoring side of the CNTKLearner round trip — the reference wrapped the
@@ -530,6 +738,22 @@ class DeepRegressor(_DeepEstimatorBase):
             return (se * w).sum() / jnp.maximum(w.sum(), 1.0)
         return loss_fn
 
+    def _make_val_step(self, module, prep, ymu, ysigma):
+        ymu_, ysig_ = float(ymu), float(ysigma)
+
+        @jax.jit
+        def f(params, batch):
+            pred = module.apply(params, prep(batch["x"]))[:, 0].astype(
+                jnp.float32) * ysig_ + ymu_
+            w = batch["w"]
+            se = (pred - batch["y"]) ** 2
+            return jnp.stack([(se * w).sum(), w.sum()])
+
+        def finalize(sums):
+            denom = max(float(sums[1]), 1.0)
+            return {"val_loss": float(sums[0]) / denom}  # MSE, label units
+        return f, finalize
+
     def _build_fitted(self, fcol, lcol, resolved_args, state_arrays, n_out,
                       ymu, ysigma):
         model = DeepRegressorModel(featuresCol=fcol, labelCol=lcol)
@@ -541,7 +765,8 @@ class DeepRegressor(_DeepEstimatorBase):
 
 
 @register_stage
-class DeepRegressorModel(HasFeaturesCol, HasLabelCol, Model):
+class DeepRegressorModel(HasFeaturesCol, HasLabelCol,
+                         _HasValidationHistory, Model):
     """Fitted deep regressor scoring through the jitted zoo architecture.
 
     Streams minibatches through the net and un-scales z-scored predictions

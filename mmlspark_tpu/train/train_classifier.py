@@ -97,7 +97,16 @@ class TrainedClassifierModel(HasLabelCol, Model):
         return self._state["levels"]
 
     def transform(self, frame: Frame) -> Frame:
-        featurized = self.get("featurizeModel").transform(frame)
+        return self.transform_featurized(
+            self.get("featurizeModel").transform(frame))
+
+    def transform_featurized(self, featurized: Frame) -> Frame:
+        """Score a frame ALREADY transformed by this model's featurizeModel.
+
+        FindBestModel featurizes once per distinct featurization and fans
+        the candidate learners out over the shared featurized frame — K
+        candidates cost ~1 featurize pass, not K (the reference re-ran the
+        full pipeline per candidate, ``FindBestModel.scala:135-143``)."""
         scored = self.get("learnerModel").transform(featurized)
         features_col = self._state.get("features_col", "features")
         scored = scored.drop(features_col).rename({
@@ -167,7 +176,11 @@ class TrainedRegressorModel(HasLabelCol, Model):
     learnerModel = AnyParam("learnerModel", "fitted regressor model")
 
     def transform(self, frame: Frame) -> Frame:
-        featurized = self.get("featurizeModel").transform(frame)
+        return self.transform_featurized(
+            self.get("featurizeModel").transform(frame))
+
+    def transform_featurized(self, featurized: Frame) -> Frame:
+        """Score a pre-featurized frame (see TrainedClassifierModel)."""
         scored = self.get("learnerModel").transform(featurized)
         features_col = self._state.get("features_col", "features")
         scored = scored.drop(features_col).rename(

@@ -66,3 +66,40 @@ def test_find_best_model_validation():
         FindBestModel(models=[m], evaluationMetric="bogus").fit(frame)
     with pytest.raises(ValueError):
         FindBestModel(models=[m], evaluationMetric="all").fit(frame)
+
+
+def test_find_best_model_shares_one_featurize_pass(monkeypatch):
+    """Candidates with semantically identical featurization (same config,
+    fit on the same data) must share ONE featurize pass: N-candidate
+    selection ~ one data pass + N cheap scoring heads (exceeds the
+    reference's per-candidate re-run, ``FindBestModel.scala:135-143``)."""
+    from mmlspark_tpu.core.pipeline import PipelineModel
+    from mmlspark_tpu.evaluate.compute_model_statistics import (
+        ComputeModelStatistics,
+    )
+
+    frame = make_census_like(n=150)
+    cands = [TrainClassifier(model=LogisticRegression(maxIter=it),
+                             labelCol="income").fit(frame)
+             for it in (1, 30, 60)]
+    # reference behavior for comparison: per-candidate full transform
+    expected = [
+        float(ComputeModelStatistics().transform(
+            c.transform(frame)).column("AUC")[0])
+        for c in cands]
+
+    calls = {"n": 0}
+    real = PipelineModel.transform
+
+    def counting(self, f):
+        calls["n"] += 1
+        return real(self, f)
+
+    monkeypatch.setattr(PipelineModel, "transform", counting)
+    fbm = FindBestModel(models=cands, evaluationMetric="AUC").fit(frame)
+    assert calls["n"] == 1  # three candidates, ONE featurize pass
+    assert fbm.get("bestModel").uid == cands[2].uid
+    cols = fbm.all_model_metrics.collect()
+    table = dict(zip(cols["model_uid"], cols["AUC"]))
+    for c, exp in zip(cands, expected):
+        np.testing.assert_allclose(float(table[c.uid]), exp, rtol=1e-6)

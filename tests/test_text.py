@@ -317,6 +317,32 @@ def test_hashing_tf_empty_fit_corpus():
     assert np.asarray(out.column("tf")).shape == (1, 0)  # degenerate, no crash
 
 
+def test_word2vec_epochs_transfer_pairs_once():
+    """Multi-epoch fit must ship the skip-gram pair arrays host->HBM ONCE
+    (the DeviceEpochCache residency contract): epochs re-permute on device,
+    so the number of host->device transfers must not scale with maxIter."""
+    import jax.numpy as jnp
+
+    def count_transfers(max_iter):
+        calls = {"n": 0}
+        real = jnp.asarray
+
+        def spy(x, *a, **k):
+            if isinstance(x, np.ndarray):
+                calls["n"] += 1
+            return real(x, *a, **k)
+
+        jnp.asarray = spy
+        try:
+            Word2Vec(inputCol="tok", outputCol="vec", vectorSize=8,
+                     minCount=2, maxIter=max_iter, seed=0).fit(_toy_corpus())
+        finally:
+            jnp.asarray = real
+        return calls["n"]
+
+    assert count_transfers(1) == count_transfers(6)
+
+
 def test_word2vec_small_pair_count_uses_all_pairs():
     # fewer pairs than batchSize: remainder must still train (vectors move)
     docs = [["red", "blue"], ["blue", "red"]] * 3
