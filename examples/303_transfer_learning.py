@@ -32,10 +32,27 @@ def main() -> dict:
         ColumnSchema("label", DType.FLOAT64),
         np.asarray([by_path[p] for p in frame.column("path")]))
 
+    # A REAL pretrained net through the ModelDownloader: the committed
+    # checkpoint (tools/make_pretrained_fixture.py) publishes into a
+    # LocalRepo and the featurizer pulls it by name — the reference's
+    # ModelDownloader + layerNames flow, with learned features instead of
+    # random init.
+    import os
+    from mmlspark_tpu.models.convert import from_flax_msgpack, import_pretrained
+    from mmlspark_tpu.models.downloader import LocalRepo, ModelDownloader
+    fixture = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "data", "pretrained",
+        "resnet20_synthetic.msgpack")
+    repo = LocalRepo(os.path.join(root, "model_repo"))
+    import_pretrained(repo, "resnet20-synthetic", "resnet20_cifar",
+                      from_flax_msgpack(fixture), dataset="synthetic-4class",
+                      input_mean=[127.5], input_std=[127.5], num_classes=4)
+
     # cutOutputLayers=1 -> the 'pool' embedding layer, not the logits head
     featurizer = ImageFeaturizer(inputCol="image", outputCol="features",
                                  cutOutputLayers=1, miniBatchSize=16)
-    featurizer.set_model("resnet20_cifar", num_classes=2, seed=0)
+    featurizer.set_model_from_downloader(ModelDownloader(repo),
+                                         "resnet20-synthetic")
     embedded = featurizer.transform(frame).drop("image", "path")
 
     parts = embedded.repartition(4).partitions

@@ -17,6 +17,7 @@ Apache Spark v0.5), re-designed TPU-first:
 
 __version__ = "0.1.0"
 
+from mmlspark_tpu.core.disk import DiskFrame, write_frame  # noqa: F401
 from mmlspark_tpu.core.frame import Frame  # noqa: F401
 from mmlspark_tpu.core.pipeline import (  # noqa: F401
     Estimator,

@@ -41,12 +41,19 @@ class ImageFeaturizer(HasInputCol, HasOutputCol, Transformer):
         super().__init__(uid, **kwargs)
 
     def set_model(self, architecture: str, params=None, seed: int = 0,
+                  input_mean=None, input_std=None,
                   **arch_kwargs) -> "ImageFeaturizer":
+        """``input_mean``/``input_std``: the normalization the net was
+        trained with (per-channel or scalar) — fused on device ahead of
+        the first layer (JaxModel.set_model owns the plumbing)."""
         self.set_params(architecture=architecture,
                         architectureArgs=dict(arch_kwargs))
         jm = JaxModel()
-        jm.set_model(architecture, params=params, seed=seed, **arch_kwargs)
-        self._state = {"params": jm._state["params"]}
+        jm.set_model(architecture, params=params, seed=seed,
+                     input_mean=input_mean, input_std=input_std,
+                     **arch_kwargs)
+        self._state = {k: v for k, v in jm._state.items()
+                       if k in ("params", "input_mu", "input_sigma")}
         self._jm_cache = None  # new params -> stale scoring model
         return self
 
@@ -54,6 +61,8 @@ class ImageFeaturizer(HasInputCol, HasOutputCol, Transformer):
         schema = downloader.repo.find_by_name(name)
         return self.set_model(schema.architecture,
                               params=downloader.load_params(name),
+                              input_mean=schema.inputMean or None,
+                              input_std=schema.inputStd or None,
                               **schema.architectureArgs)
 
     def transform(self, frame: Frame) -> Frame:
@@ -123,6 +132,9 @@ class ImageFeaturizer(HasInputCol, HasOutputCol, Transformer):
             jm.set_params(architecture=self.architecture,
                           architectureArgs=self.get("architectureArgs"))
             jm._state = {"params": self._state["params"]}
+            for k in ("input_mu", "input_sigma"):
+                if k in self._state:
+                    jm._state[k] = self._state[k]
             self._jm_cache, self._jm_key = jm, key
         else:
             jm.set_params(inputCol=tmp_vec, outputCol=self.outputCol)

@@ -1,0 +1,206 @@
+"""Pretrained-weight import: standard checkpoint formats -> zoo params.
+
+The reference's ModelDownloader served ~20 actually-trained CNTK models
+(``downloader/src/main/scala/ModelDownloader.scala:24-260``); the repository
+mechanics here (LocalRepo/HttpRepo, sha256, MANIFEST) are format-complete
+but need real payloads. This module feeds them from the two checkpoint
+formats a JAX/torch user actually has:
+
+- **flax msgpack** (``flax.serialization.msgpack_serialize``): the native
+  JAX checkpoint container — restored 1:1 into zoo param pytrees;
+- **torch state_dict exported as npz** (``numpy.savez(**{k: v.numpy()})``):
+  torch's dotted module paths become the flax nesting, and each tensor is
+  re-laid-out from torch's conventions to flax's (Linear ``weight``
+  (out, in) -> ``kernel`` (in, out); Conv2d OIHW -> HWIO; Conv1d (out, in,
+  k) -> (k, in, out); BatchNorm ``weight``/``bias``/``running_*`` ->
+  ``scale``/``bias``/``mean``/``var``).
+
+``validate_params`` checks an imported pytree leaf-by-leaf against the zoo
+architecture's ``init`` structure (paths AND shapes) before anything is
+published, so a converted checkpoint either drops in exactly or fails with
+the full mismatch list. ``import_pretrained`` then publishes through
+``LocalRepo.save_model`` with the schema's ``layerNames`` filled from the
+zoo spec — the ``cutOutputLayers`` transfer-learning contract
+(``ImageFeaturizer.scala:85-120``).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from mmlspark_tpu.models.downloader import LocalRepo, ModelSchema
+
+
+# -- flax msgpack ------------------------------------------------------------
+
+def from_flax_msgpack(source: Union[str, bytes]) -> Dict[str, Any]:
+    """Restore a flax msgpack checkpoint (path or raw bytes) into a plain
+    nested dict of numpy arrays."""
+    from flax import serialization
+    if isinstance(source, (str, os.PathLike)):
+        with open(source, "rb") as f:
+            source = f.read()
+    tree = serialization.msgpack_restore(source)
+    return _to_numpy(tree)
+
+
+def to_flax_msgpack(params: Any, path: Optional[str] = None) -> bytes:
+    """Serialize a param pytree to flax msgpack bytes (optionally saved)."""
+    from flax import serialization
+    data = serialization.msgpack_serialize(_to_numpy(params))
+    if path is not None:
+        with open(path, "wb") as f:
+            f.write(data)
+    return data
+
+
+def _to_numpy(tree: Any) -> Any:
+    if isinstance(tree, dict):
+        return {k: _to_numpy(v) for k, v in tree.items()}
+    return np.asarray(tree)
+
+
+# -- torch state_dict (npz container) ----------------------------------------
+
+_TORCH_DROP = ("num_batches_tracked",)
+
+
+def _convert_torch_leaf(leaf_name: str, arr: np.ndarray
+                        ) -> Optional[Tuple[str, np.ndarray]]:
+    """(flax leaf name, re-laid-out array) for one torch tensor, or None
+    for bookkeeping tensors that have no flax counterpart."""
+    if leaf_name in _TORCH_DROP:
+        return None
+    if leaf_name == "weight":
+        if arr.ndim == 2:          # Linear (out, in) -> kernel (in, out)
+            return "kernel", arr.T
+        if arr.ndim == 4:          # Conv2d OIHW -> HWIO
+            return "kernel", arr.transpose(2, 3, 1, 0)
+        if arr.ndim == 3:          # Conv1d (out, in, k) -> (k, in, out)
+            return "kernel", arr.transpose(2, 1, 0)
+        return "scale", arr        # norm layers keep 1-D weight as scale
+    if leaf_name == "running_mean":
+        return "mean", arr
+    if leaf_name == "running_var":
+        return "var", arr
+    return leaf_name, arr          # bias and friends pass through
+
+
+def from_torch_npz(source: Union[str, Dict[str, np.ndarray]]
+                   ) -> Dict[str, Any]:
+    """Torch ``state_dict`` (exported as npz, or an in-memory dict of
+    numpy arrays) -> flax-style nested params under ``{"params": ...}``.
+
+    The dotted torch key path becomes the flax module nesting verbatim —
+    the torch module names must match the flax submodule names (the zoo's
+    names are stable and documented per architecture); only the LEAF
+    name/layout is translated.
+    """
+    if isinstance(source, (str, os.PathLike)):
+        with np.load(source, allow_pickle=False) as z:
+            flat = {k: np.asarray(z[k]) for k in z.files}
+    else:
+        flat = {k: np.asarray(v) for k, v in source.items()}
+    tree: Dict[str, Any] = {}
+    for key, arr in flat.items():
+        parts = key.split(".")
+        converted = _convert_torch_leaf(parts[-1], arr)
+        if converted is None:
+            continue
+        leaf, value = converted
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[leaf] = value
+    return {"params": tree}
+
+
+# -- validation + publishing -------------------------------------------------
+
+def _flat_shapes(tree: Any, prefix: str = "") -> Dict[str, Tuple]:
+    out: Dict[str, Tuple] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flat_shapes(v, f"{prefix}{k}/"))
+    else:
+        out[prefix.rstrip("/")] = tuple(np.shape(tree))
+    return out
+
+
+def validate_params(architecture: str, params: Any,
+                    **arch_kwargs) -> Dict[str, Any]:
+    """Check an imported pytree against ``architecture``'s own init
+    structure (leaf paths and shapes). Returns the params cast to the init
+    dtypes; raises ValueError listing every mismatch otherwise."""
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.models.zoo import build_model
+    spec = build_model(architecture, **arch_kwargs)
+    module = spec["module"]
+    shape = (1,) + tuple(spec["input_shape"])
+    dt = jnp.int32 if spec.get("input_dtype") == "int32" else jnp.float32
+    target = jax.eval_shape(
+        lambda: module.init(jax.random.PRNGKey(0), jnp.zeros(shape, dt)))
+    target = _to_numpy_shapes(target)
+    got = _flat_shapes(_to_numpy(params))
+    want = _flat_shapes(target)
+    missing = sorted(set(want) - set(got))
+    unexpected = sorted(set(got) - set(want))
+    wrong = sorted(k for k in set(want) & set(got) if want[k] != got[k])
+    if missing or unexpected or wrong:
+        raise ValueError(
+            f"params do not match architecture {architecture!r}:\n"
+            f"  missing: {missing}\n  unexpected: {unexpected}\n"
+            f"  shape mismatches: "
+            f"{[(k, got[k], want[k]) for k in wrong]}")
+    # cast to the init leaf dtypes (e.g. a float64 numpy export -> float32)
+    dtypes = _flat_dtypes(target)
+
+    def cast(tree, prefix=""):
+        if isinstance(tree, dict):
+            return {k: cast(v, f"{prefix}{k}/") for k, v in tree.items()}
+        return np.asarray(tree, dtype=dtypes[prefix.rstrip("/")])
+    return cast(_to_numpy(params))
+
+
+def _to_numpy_shapes(tree: Any) -> Any:
+    """ShapeDtypeStruct pytree -> zero arrays (shape/dtype carriers)."""
+    import jax
+    return jax.tree_util.tree_map(
+        lambda s: np.zeros(s.shape, s.dtype), tree)
+
+
+def _flat_dtypes(tree: Any, prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flat_dtypes(v, f"{prefix}{k}/"))
+    else:
+        out[prefix.rstrip("/")] = np.asarray(tree).dtype
+    return out
+
+
+def import_pretrained(repo: LocalRepo, name: str, architecture: str,
+                      params: Any, dataset: str = "",
+                      input_mean: Optional[List[float]] = None,
+                      input_std: Optional[List[float]] = None,
+                      **arch_kwargs) -> ModelSchema:
+    """Validate ``params`` against ``architecture`` and publish them into
+    ``repo`` with a complete ModelSchema (layerNames from the zoo spec, the
+    reference's transfer-learning contract; ``input_mean``/``input_std``
+    record the normalization the net was trained with). Returns the
+    written schema."""
+    from mmlspark_tpu.models.zoo import build_model
+    params = validate_params(architecture, params, **arch_kwargs)
+    spec = build_model(architecture, **arch_kwargs)
+    layer_names: List[str] = list(spec.get("layer_names", []))
+    schema = ModelSchema(
+        name=name, architecture=architecture, dataset=dataset,
+        inputNode=spec.get("feature_layer", ""),
+        numLayers=len(layer_names), layerNames=layer_names,
+        architectureArgs=dict(arch_kwargs),
+        inputMean=[float(v) for v in (input_mean or [])],
+        inputStd=[float(v) for v in (input_std or [])])
+    return repo.save_model(schema, params)

@@ -37,6 +37,12 @@ class ModelSchema:
     numLayers: int = 0
     layerNames: List[str] = field(default_factory=list)
     architectureArgs: Dict[str, Any] = field(default_factory=dict)
+    # input preprocessing the net was trained with (per-channel or scalar;
+    # empty = raw). The reference's CNTK graphs embedded their own input
+    # normalization; here it rides the schema so a downloaded model scores
+    # the distribution it was trained on.
+    inputMean: List[float] = field(default_factory=list)
+    inputStd: List[float] = field(default_factory=list)
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), sort_keys=True)
@@ -156,6 +162,8 @@ class ModelDownloader:
         params = self.load_params(name)
         m = JaxModel(**jax_model_kwargs)
         m.set_model(schema.architecture, params=params,
+                    input_mean=schema.inputMean or None,
+                    input_std=schema.inputStd or None,
                     **schema.architectureArgs)
         return m
 

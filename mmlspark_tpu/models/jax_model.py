@@ -53,8 +53,15 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         "first layer instead of running per-image on the host.", {})
 
     def set_model(self, architecture: str, params: Optional[Any] = None,
-                  seed: int = 0, **arch_kwargs) -> "JaxModel":
-        """Attach architecture + params (random-init if params is None)."""
+                  seed: int = 0, input_mean=None, input_std=None,
+                  **arch_kwargs) -> "JaxModel":
+        """Attach architecture + params (random-init if params is None).
+
+        ``input_mean``/``input_std`` (per-channel, scalar, or anything
+        broadcastable against the model input) record the normalization
+        the net was trained with — fused on device ahead of the first
+        layer. THE single place this plumbing lives; downloader and
+        featurizer route through here."""
         self.set_params(architecture=architecture,
                         architectureArgs=dict(arch_kwargs))
         spec = build_model(architecture, **arch_kwargs)
@@ -64,9 +71,14 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
             dtype = jnp.int32 if spec.get("input_dtype") == "int32" else jnp.float32
             x = jnp.zeros(shape, dtype)
             params = module.init(jax.random.PRNGKey(seed), x)
+        state = {"params": _to_plain(params)}
+        if input_mean is not None:
+            state["input_mu"] = np.asarray(input_mean, np.float32)
+            state["input_sigma"] = np.asarray(
+                input_std if input_std is not None else [1.0], np.float32)
         # _set_state (not a bare assignment) so a previously compiled
         # closure over OLD params is invalidated
-        self._set_state({"params": _to_plain(params)})
+        self._set_state(state)
         return self
 
     # -- internals ---------------------------------------------------------

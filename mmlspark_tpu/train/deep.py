@@ -109,6 +109,10 @@ def _train_val_split(frame: Frame, frac: float, seed: int
     globally through the sharded eval."""
     if not frame.schema.names:
         raise ValueError("cannot split an empty-schema frame")
+    if getattr(frame, "_out_of_core", False):
+        raise ValueError(
+            "validationSplit would materialize an out-of-core DiskFrame; "
+            "stage separate train/val DiskFrame directories instead")
     rng = np.random.default_rng([seed, 715])
     first = frame.schema.names[0]
     tr_parts, va_parts = [], []

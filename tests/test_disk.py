@@ -1,0 +1,213 @@
+"""DiskFrame: bigger-than-memory frames over memory-mapped chunks.
+
+Capability being matched: the reference inherited out-of-core datasets from
+Spark (SURVEY.md §1, L0) — partitions on disk streaming through the
+training path with bounded memory.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.disk import DiskFrame, write_frame
+from mmlspark_tpu.core.frame import Frame
+from mmlspark_tpu.core.schema import ColumnSchema, DType, Schema, SchemaError
+
+
+def _frame(n=1000, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64)
+    return Frame.from_dict({"features": X, "label": y})
+
+
+def test_write_open_roundtrip(tmp_path):
+    f = _frame(n=1000)
+    write_frame(f, str(tmp_path / "df"), rows_per_chunk=256)
+    df = DiskFrame.open(str(tmp_path / "df"))
+    assert df.count() == 1000
+    assert df.num_partitions == 4  # ceil(1000/256)
+    assert df.schema.names == ["features", "label"]
+    assert df.schema["features"].dim == 6
+    np.testing.assert_array_equal(
+        np.concatenate([b["features"] for b in df.batches(300)]),
+        f.column("features"))
+    # head() works off the memmap without materializing the frame
+    assert len(df.head(3)) == 3
+
+
+def test_streaming_write_with_explicit_schema(tmp_path):
+    schema = Schema([ColumnSchema("x", DType.VECTOR, 4),
+                     ColumnSchema("y", DType.INT32)])
+    rng = np.random.default_rng(1)
+
+    def gen():
+        for _ in range(10):  # ragged batch sizes crossing chunk bounds
+            n = int(rng.integers(50, 150))
+            yield {"x": rng.normal(size=(n, 4)).astype(np.float32),
+                   "y": rng.integers(0, 3, n).astype(np.int32)}
+
+    write_frame(gen(), str(tmp_path / "df"), rows_per_chunk=128,
+                schema=schema)
+    df = DiskFrame.open(str(tmp_path / "df"))
+    assert df.count() > 0
+    rows = sum(len(b["y"]) for b in df.batches(64))
+    assert rows == df.count()
+    with pytest.raises(SchemaError, match="explicit schema"):
+        write_frame(iter([]), str(tmp_path / "df2"))
+
+
+def test_chunks_pinned_to_schema_dtype_and_ragged_rejected(tmp_path):
+    schema = Schema([ColumnSchema("x", DType.VECTOR, 2),
+                     ColumnSchema("y", DType.INT32)])
+
+    def gen():  # float64 lists one batch, float32 arrays the next
+        yield {"x": [[0.5, 1.5]], "y": [1]}
+        yield {"x": np.zeros((3, 2), np.float32), "y": np.zeros(3, np.int64)}
+
+    write_frame(gen(), str(tmp_path / "df"), rows_per_chunk=2, schema=schema)
+    df = DiskFrame.open(str(tmp_path / "df"))
+    for b in df.batches(2):
+        assert b["x"].dtype == np.float32  # ONE dtype per column, always
+        assert b["y"].dtype == np.int32
+
+    with pytest.raises(SchemaError, match="ragged batch"):
+        write_frame(iter([{"x": np.zeros((2, 2), np.float32),
+                           "y": np.zeros(3, np.int32)}]),
+                    str(tmp_path / "df2"), schema=schema)
+
+
+def test_validation_split_refuses_disk_frame(tmp_path):
+    from mmlspark_tpu.train.deep import DeepClassifier
+    f = _frame(n=200)
+    write_frame(f, str(tmp_path / "df"), rows_per_chunk=64)
+    df = DiskFrame.open(str(tmp_path / "df"))
+    learner = DeepClassifier(batchSize=64, epochs=1, validationSplit=0.2)
+    learner.set_params(featuresCol="features", labelCol="label")
+    with pytest.raises(ValueError, match="out-of-core"):
+        learner.fit(df)
+
+
+def test_object_columns_rejected(tmp_path):
+    f = Frame.from_dict({"s": ["a", "b"], "v": [1.0, 2.0]})
+    with pytest.raises(SchemaError, match="numeric/vector"):
+        write_frame(f, str(tmp_path / "df"))
+
+
+def test_shuffled_batches_cover_every_row_once(tmp_path):
+    f = _frame(n=1117)
+    write_frame(f, str(tmp_path / "df"), rows_per_chunk=128)
+    df = DiskFrame.open(str(tmp_path / "df"))
+    seen = []
+    for b in df.shuffled_batches(64, rng=np.random.default_rng(3)):
+        assert len(b["label"]) <= 64
+        seen.append(b["features"][:, 0])
+    got = np.sort(np.concatenate(seen))
+    np.testing.assert_array_equal(got, np.sort(f.column("features")[:, 0]))
+    # deterministic under a seeded rng; different across seeds
+    first = [b["features"][:3, 0].tolist()
+             for b in df.shuffled_batches(64, rng=np.random.default_rng(3))]
+    again = [b["features"][:3, 0].tolist()
+             for b in df.shuffled_batches(64, rng=np.random.default_rng(3))]
+    other = [b["features"][:3, 0].tolist()
+             for b in df.shuffled_batches(64, rng=np.random.default_rng(4))]
+    assert first == again
+    assert first != other
+
+
+def test_deep_classifier_trains_on_disk_frame(tmp_path):
+    """DeepClassifier streams a DiskFrame end to end (budget declines the
+    device cache -> streaming path -> bounded-memory shuffle)."""
+    from mmlspark_tpu.train.deep import DeepClassifier
+    from mmlspark_tpu.utils import config
+
+    f = _frame(n=2000, d=8, seed=5)
+    write_frame(f, str(tmp_path / "df"), rows_per_chunk=256)
+    df = DiskFrame.open(str(tmp_path / "df"))
+    config.set("runtime.device_cache_mb", 0.01)  # force streaming
+    try:
+        learner = DeepClassifier(architecture="mlp_tabular",
+                                 architectureArgs={"hidden": [16]},
+                                 batchSize=128, epochs=3, learningRate=1e-2)
+        learner.set_params(featuresCol="features", labelCol="label")
+        model = learner.fit(df)
+    finally:
+        config.unset("runtime.device_cache_mb")
+    pred = np.asarray(model.transform(df).column("prediction"))
+    assert (pred == np.asarray(f.column("label"))).mean() > 0.9
+
+
+_RSS_WORKER = textwrap.dedent("""
+    import resource, sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from mmlspark_tpu.core.disk import DiskFrame
+    from mmlspark_tpu.train.deep import DeepClassifier
+    from mmlspark_tpu.utils import config
+
+    path, mode = sys.argv[1], sys.argv[2]
+    frame = DiskFrame.open(path)
+    if mode == "materialize":
+        # control: the in-memory route — materialize every column into a
+        # plain Frame, then run the IDENTICAL fit
+        from mmlspark_tpu.core.frame import Frame
+        frame = Frame(frame.schema,
+                      [{n: np.ascontiguousarray(frame.column(n))
+                        for n in frame.schema.names}])
+    config.set("runtime.device_cache_mb", 0.01)
+    learner = DeepClassifier(architecture="mlp_tabular",
+                             architectureArgs={"hidden": [8]},
+                             batchSize=4096, epochs=1,
+                             learningRate=1e-2)
+    learner.set_params(featuresCol="features", labelCol="label")
+    learner.fit(frame)
+    print("RSS", resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+""")
+
+
+@pytest.mark.slow
+def test_bigger_than_budget_fit_bounded_rss(tmp_path):
+    """A fit over a DiskFrame much larger than the streaming window keeps
+    peak RSS well below the dataset size; a control process that
+    materializes the same frame pays the full size. Comparative, so the
+    assertion is robust to the runtime's own baseline footprint."""
+    n, d = 600_000, 64  # ~150 MB of float32 features
+    rng = np.random.default_rng(9)
+    schema = Schema([ColumnSchema("features", DType.VECTOR, d),
+                     ColumnSchema("label", DType.INT64)])
+
+    def gen():
+        for _ in range(n // 50_000):
+            X = rng.normal(size=(50_000, d)).astype(np.float32)
+            yield {"features": X, "label": (X[:, 0] > 0).astype(np.int64)}
+
+    path = str(tmp_path / "big")
+    # small chunks -> small shuffle window -> small streaming working set
+    write_frame(gen(), path, rows_per_chunk=20_000, schema=schema)
+    data_mb = sum(os.path.getsize(os.path.join(r, f))
+                  for r, _, fs in os.walk(path) for f in fs) / 1e6
+    assert data_mb > 140
+
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # single device: no 8x runtime overhead
+
+    def rss_mb(mode):
+        out = subprocess.run(
+            [sys.executable, "-c", _RSS_WORKER, path, mode],
+            env=env, capture_output=True, text=True, timeout=600)
+        assert out.returncode == 0, out.stderr[-3000:]
+        line = [l for l in out.stdout.splitlines() if l.startswith("RSS")][0]
+        return int(line.split()[1]) / 1024  # KiB -> MiB on linux
+
+    stream, control = rss_mb("stream"), rss_mb("materialize")
+    # the streaming fit must stay well under the dataset's own size while
+    # the materializing control pays for all of it on top of the runtime
+    assert control - stream > data_mb * 0.4, (stream, control, data_mb)
+    assert stream < control, (stream, control)

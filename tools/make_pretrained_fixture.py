@@ -1,0 +1,107 @@
+"""Build the committed pretrained-model fixture: a REALLY-trained
+resnet20_cifar on a deterministic synthetic 4-class image task, saved as a
+flax msgpack checkpoint plus golden activations.
+
+The reference shipped ~20 trained CNTK models through its ModelDownloader
+(``ModelDownloader.scala:24-260``) and pinned expected activations in tests
+(``CNTKTestUtils.scala:13-36``); this is the equivalent seed content for
+this framework's repository: small enough to commit, trained enough that
+transfer-learning examples/tests exercise REAL learned features rather than
+random init.
+
+Run from the repo root (CPU is fine, ~1 min):
+
+    JAX_PLATFORMS=cpu python tools/make_pretrained_fixture.py
+
+Outputs under tests/data/pretrained/:
+    resnet20_synthetic.msgpack   trained params (flax msgpack)
+    golden.npz                   input batch + expected pool activations
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "data", "pretrained")
+N_CLASSES = 4
+STEPS = 400
+BATCH = 64
+
+
+def make_batch(rng: np.random.Generator, n: int):
+    """4 visually distinct classes: red-ish / green-ish / blue-ish tints
+    and a luminance gradient — separable but not trivially so under noise."""
+    y = rng.integers(0, N_CLASSES, size=n)
+    x = rng.normal(110, 45, size=(n, 32, 32, 3))
+    for i, cls in enumerate(y):
+        if cls < 3:
+            x[i, :, :, cls] += 55.0
+        else:
+            x[i] += np.linspace(-50, 50, 32)[None, :, None]
+    return np.clip(x, 0, 255).astype(np.uint8), y.astype(np.int32)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from mmlspark_tpu.models.convert import to_flax_msgpack
+    from mmlspark_tpu.models.zoo import build_model
+
+    spec = build_model("resnet20_cifar", num_classes=N_CLASSES)
+    module = spec["module"]
+    rng = np.random.default_rng(7)
+
+    def loss_fn(params, x, y):
+        logits = module.apply(
+            params, x.astype(jnp.float32) / 127.5 - 1.0).astype(jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    opt = optax.adamw(3e-3, weight_decay=1e-4)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 32, 32, 3), jnp.float32))
+    opt_state = opt.init(params)
+    for i in range(STEPS):
+        x, y = make_batch(rng, BATCH)
+        params, opt_state, loss = step(params, opt_state,
+                                       jnp.asarray(x), jnp.asarray(y))
+        if i % 100 == 0:
+            print(f"step {i} loss {float(loss):.4f}")
+
+    # held-out accuracy: proof this is a trained model, recorded for tests
+    xe, ye = make_batch(np.random.default_rng(999), 256)
+    logits = module.apply(params, jnp.asarray(xe, jnp.float32) / 127.5 - 1.0)
+    acc = float((np.asarray(jnp.argmax(logits, -1)) == ye).mean())
+    print(f"eval accuracy {acc:.4f}")
+    assert acc > 0.9, "fixture must be genuinely trained"
+
+    os.makedirs(OUT, exist_ok=True)
+    to_flax_msgpack(params, os.path.join(OUT, "resnet20_synthetic.msgpack"))
+
+    # golden activations: fixed input batch -> pool-layer features
+    from mmlspark_tpu.models.zoo.resnet import apply_with_intermediates
+    xg, yg = make_batch(np.random.default_rng(123), 8)
+    _, inters = apply_with_intermediates(
+        module, params, jnp.asarray(xg, jnp.float32) / 127.5 - 1.0)
+    pool = np.asarray([v for k, v in sorted(inters.items())
+                       if k == "pool" or k.endswith("/pool")][0],
+                      np.float32)
+    np.savez(os.path.join(OUT, "golden.npz"),
+             images=xg, labels=yg, pool=pool,
+             eval_accuracy=np.asarray(acc, np.float32))
+    print(f"wrote fixture to {OUT} "
+          f"({os.path.getsize(os.path.join(OUT, 'resnet20_synthetic.msgpack')) >> 10} KB)")
+
+
+if __name__ == "__main__":
+    main()
