@@ -156,6 +156,9 @@ def write_frame(source, directory: str, rows_per_chunk: int = 65536,
         return arr if arr.dtype == want else arr.astype(want)
 
     for hb in batches:
+        if set(hb) != set(schema.names):
+            raise SchemaError(
+                f"batch columns {sorted(hb)} != schema {schema.names}")
         hb = {k: cast(k, np.asarray(v)) for k, v in hb.items()}
         lens = {k: len(v) for k, v in hb.items()}
         if len(set(lens.values())) > 1:
@@ -209,30 +212,13 @@ class DiskFrame(Frame):
     def count(self) -> int:
         return sum(p._rows for p in self.partitions)
 
-    def batches(self, batch_size: int, cols: Optional[Sequence[str]] = None,
-                drop_remainder: bool = False
-                ) -> Iterator[Dict[str, np.ndarray]]:
-        """Frame.batches semantics (stacking across chunk boundaries) with
-        per-chunk page eviction once a chunk is fully consumed."""
-        cols = list(cols) if cols is not None else self.schema.names
-        buf: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
-        buffered = 0
-        for p in self.partitions:
-            n = p._rows
-            off = 0
-            while off < n:
-                take = min(batch_size - buffered, n - off)
-                for c in cols:
-                    buf[c].append(p[c][off:off + take])
-                buffered += take
-                off += take
-                if buffered == batch_size:
-                    yield {c: _cat_copy(buf[c]) for c in cols}
-                    buf = {c: [] for c in cols}
-                    buffered = 0
-            p.release()
-        if buffered and not drop_remainder:
-            yield {c: _cat_copy(buf[c]) for c in cols}
+    # Frame.batches drives the loop; these hooks add the out-of-core
+    # behavior: batches must be REAL arrays (not views into evictable
+    # mmaps), and a chunk's pages evict once it is fully consumed.
+    _cat_batch = staticmethod(_cat_copy)
+
+    def _partition_consumed(self, p) -> None:
+        p.release()
 
     def shuffled_batches(self, batch_size: int,
                          cols: Optional[Sequence[str]] = None,

@@ -466,6 +466,13 @@ class Frame:
         return self
 
     # -- device streaming --------------------------------------------------
+    # Subclass hooks for batches(): DiskFrame swaps the batch assembler for
+    # a must-copy variant and evicts a chunk's pages once it is consumed.
+    _cat_batch = staticmethod(lambda arrs: _cat(arrs))
+
+    def _partition_consumed(self, p: Partition) -> None:
+        pass
+
     def batches(self, batch_size: int, cols: Optional[Sequence[str]] = None,
                 drop_remainder: bool = False) -> Iterator[Dict[str, np.ndarray]]:
         """Yield stacked numpy minibatches across partition boundaries.
@@ -487,11 +494,12 @@ class Frame:
                 buffered += take
                 off += take
                 if buffered == batch_size:
-                    yield {c: _cat(buf[c]) for c in cols}
+                    yield {c: self._cat_batch(buf[c]) for c in cols}
                     buf = {c: [] for c in cols}
                     buffered = 0
+            self._partition_consumed(p)
         if buffered and not drop_remainder:
-            yield {c: _cat(buf[c]) for c in cols}
+            yield {c: self._cat_batch(buf[c]) for c in cols}
 
     def shuffled_batches(self, batch_size: int, cols: Optional[Sequence[str]] = None,
                          rng: Optional[np.random.Generator] = None,

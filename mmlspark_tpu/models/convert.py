@@ -129,7 +129,7 @@ def _flat_shapes(tree: Any, prefix: str = "") -> Dict[str, Tuple]:
     return out
 
 
-def validate_params(architecture: str, params: Any,
+def validate_params(architecture: str, params: Any, _spec=None,
                     **arch_kwargs) -> Dict[str, Any]:
     """Check an imported pytree against ``architecture``'s own init
     structure (leaf paths and shapes). Returns the params cast to the init
@@ -137,15 +137,16 @@ def validate_params(architecture: str, params: Any,
     import jax
     import jax.numpy as jnp
     from mmlspark_tpu.models.zoo import build_model
-    spec = build_model(architecture, **arch_kwargs)
+    spec = _spec if _spec is not None else build_model(architecture,
+                                                      **arch_kwargs)
     module = spec["module"]
     shape = (1,) + tuple(spec["input_shape"])
     dt = jnp.int32 if spec.get("input_dtype") == "int32" else jnp.float32
+    # abstract only — ShapeDtypeStructs carry shape/dtype, nothing allocates
     target = jax.eval_shape(
         lambda: module.init(jax.random.PRNGKey(0), jnp.zeros(shape, dt)))
-    target = _to_numpy_shapes(target)
     got = _flat_shapes(_to_numpy(params))
-    want = _flat_shapes(target)
+    want = {k: tuple(s.shape) for k, s in _flat_leaves(target).items()}
     missing = sorted(set(want) - set(got))
     unexpected = sorted(set(got) - set(want))
     wrong = sorted(k for k in set(want) & set(got) if want[k] != got[k])
@@ -156,7 +157,7 @@ def validate_params(architecture: str, params: Any,
             f"  shape mismatches: "
             f"{[(k, got[k], want[k]) for k in wrong]}")
     # cast to the init leaf dtypes (e.g. a float64 numpy export -> float32)
-    dtypes = _flat_dtypes(target)
+    dtypes = {k: s.dtype for k, s in _flat_leaves(target).items()}
 
     def cast(tree, prefix=""):
         if isinstance(tree, dict):
@@ -165,20 +166,13 @@ def validate_params(architecture: str, params: Any,
     return cast(_to_numpy(params))
 
 
-def _to_numpy_shapes(tree: Any) -> Any:
-    """ShapeDtypeStruct pytree -> zero arrays (shape/dtype carriers)."""
-    import jax
-    return jax.tree_util.tree_map(
-        lambda s: np.zeros(s.shape, s.dtype), tree)
-
-
-def _flat_dtypes(tree: Any, prefix: str = "") -> Dict[str, Any]:
+def _flat_leaves(tree: Any, prefix: str = "") -> Dict[str, Any]:
     out: Dict[str, Any] = {}
     if isinstance(tree, dict):
         for k, v in tree.items():
-            out.update(_flat_dtypes(v, f"{prefix}{k}/"))
+            out.update(_flat_leaves(v, f"{prefix}{k}/"))
     else:
-        out[prefix.rstrip("/")] = np.asarray(tree).dtype
+        out[prefix.rstrip("/")] = tree
     return out
 
 
@@ -193,8 +187,8 @@ def import_pretrained(repo: LocalRepo, name: str, architecture: str,
     record the normalization the net was trained with). Returns the
     written schema."""
     from mmlspark_tpu.models.zoo import build_model
-    params = validate_params(architecture, params, **arch_kwargs)
     spec = build_model(architecture, **arch_kwargs)
+    params = validate_params(architecture, params, _spec=spec, **arch_kwargs)
     layer_names: List[str] = list(spec.get("layer_names", []))
     schema = ModelSchema(
         name=name, architecture=architecture, dataset=dataset,

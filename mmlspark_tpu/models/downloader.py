@@ -116,6 +116,17 @@ class LocalRepo(Repository):
             f.write(schema.to_json())
         return schema
 
+    def write_manifest(self) -> str:
+        """Write the ``MANIFEST`` file (one schema JSON per line) that
+        HttpRepo clients list — serving this directory over any static
+        HTTP server makes it a remote model repository, the publishing
+        half of the reference's DefaultModelRepo."""
+        path = os.path.join(self.root, "MANIFEST")
+        with open(path, "w") as f:
+            for s in self.list_schemas():
+                f.write(s.to_json() + "\n")
+        return path
+
 
 class HttpRepo(Repository):
     """Remote repository: <base>/MANIFEST lists schema JSON, one per line."""

@@ -72,8 +72,9 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
             x = jnp.zeros(shape, dtype)
             params = module.init(jax.random.PRNGKey(seed), x)
         state = {"params": _to_plain(params)}
-        if input_mean is not None:
-            state["input_mu"] = np.asarray(input_mean, np.float32)
+        if input_mean is not None or input_std is not None:
+            state["input_mu"] = np.asarray(
+                input_mean if input_mean is not None else [0.0], np.float32)
             state["input_sigma"] = np.asarray(
                 input_std if input_std is not None else [1.0], np.float32)
         # _set_state (not a bare assignment) so a previously compiled

@@ -44,8 +44,9 @@ class FeaturizeHints:
 
 
 class HasBatchSize:
-    """Mixin for learners that stream minibatches (trees don't: histogram
-    CART materializes the binned dataset by construction)."""
+    """Mixin for learners that stream minibatches (trees instead stream a
+    binning pass into a uint8 matrix — histogram CART keeps the whole
+    BINNED dataset, at 1 byte/cell)."""
     batchSize = IntParam("batchSize", "minibatch rows per optimizer step",
                          8192, validator=lambda v: v > 0)
 
@@ -56,8 +57,8 @@ class JaxEstimator(HasFeaturesCol, HasLabelCol, Estimator):
     Iterative learners train in O(batch) device memory: one jitted step at a
     single compiled shape, tail batches zero-padded and masked by a per-row
     weight (the reference's pad-and-drop workaround ``CNTKModel.scala:71-76``
-    done the XLA way). Tree learners (`train/trees.py`) still materialize the
-    dataset — histogram CART needs global quantile bins by construction.
+    done the XLA way). Tree learners (`train/trees.py`) stream a binning
+    pass instead and keep only the uint8 bin matrix.
     """
 
     hints = FeaturizeHints()

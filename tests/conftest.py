@@ -37,8 +37,8 @@ def pytest_sessionstart(session):
         # the env var is only meaningful paired with the -m tpu lane; a
         # full suite on the ambient backend would fail confusingly at
         # every mesh-shape assumption, so refuse up front
-        marker = session.config.getoption("-m") or ""
-        assert "tpu" in marker, (
+        marker = (session.config.getoption("-m") or "").strip()
+        assert marker == "tpu", (
             "MMLSPARK_TEST_TPU=1 runs the real-accelerator smoke lane "
             "only: add -m tpu (or use ./tools/runme testtpu), or unset "
             "the variable for the virtual-CPU-mesh suite")

@@ -178,7 +178,8 @@ def test_http_repo_manifest_download_and_cache(tmp_path):
                          architectureArgs={"input_dim": 4, "hidden": [8],
                                            "num_classes": 2})
     schema = publish.save_model(schema, params)
-    (serve_dir / "MANIFEST").write_text(schema.to_json() + "\n")
+    publish.write_manifest()  # the publishing half of DefaultModelRepo
+    assert (serve_dir / "MANIFEST").read_text().strip() == schema.to_json()
 
     handler = functools.partial(http.server.SimpleHTTPRequestHandler,
                                 directory=str(serve_dir))

@@ -264,3 +264,38 @@ def test_rf_regressor_rejects_zero_trees():
     import pytest as _pt
     with _pt.raises(Exception):
         RandomForestRegressor(numTrees=0)
+
+
+def test_tree_prep_streams_to_uint8_bins():
+    """_prep must produce a uint8 bin matrix (1 byte/cell) without ever
+    materializing the fp32 feature matrix (streamed batches only)."""
+    from mmlspark_tpu.core.frame import Frame
+    from mmlspark_tpu.train.trees import DecisionTreeClassifier
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 5)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64)
+    frame = Frame.from_dict({"features": X, "label": y}, num_partitions=3)
+    learner = DecisionTreeClassifier(maxDepth=3)
+    learner.set_params(featuresCol="features", labelCol="label")
+    yy, edges, Xb = learner._prep(frame)
+    assert Xb.dtype == np.uint8 and Xb.shape == (500, 5)
+    assert len(yy) == 500
+
+
+def test_random_forest_fits_disk_frame(tmp_path):
+    """Histogram trees stream a DiskFrame: edges from the sampled pass,
+    uint8 bins built chunk by chunk — no fp32 materialization."""
+    from mmlspark_tpu.core.disk import DiskFrame, write_frame
+    from mmlspark_tpu.core.frame import Frame
+    from mmlspark_tpu.train.trees import RandomForestClassifier
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(2000, 6)).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1]) > 0).astype(np.int64)
+    write_frame(Frame.from_dict({"features": X, "label": y}),
+                str(tmp_path / "df"), rows_per_chunk=256)
+    df = DiskFrame.open(str(tmp_path / "df"))
+    learner = RandomForestClassifier(numTrees=5, maxDepth=4, seed=0)
+    learner.set_params(featuresCol="features", labelCol="label")
+    model = learner.fit(df)
+    pred = np.asarray(model.transform(df).column("prediction"))
+    assert (pred == y).mean() > 0.9
