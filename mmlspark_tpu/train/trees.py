@@ -272,9 +272,15 @@ class _TreeParams(JaxEstimator):
             sample.append(x if take >= 1.0
                           else x[rng.random(len(x)) < take])
         y = np.concatenate(ys)
-        edges = make_bin_edges(np.concatenate(sample), self.maxBins)
-        del sample
+        full = np.concatenate(sample) if len(sample) > 1 else sample[0]
+        edges = make_bin_edges(full, self.maxBins)
         Xb = np.empty((n, F), np.uint8)  # maxBins <= 256 -> bins fit uint8
+        if take >= 1.0:
+            # the "sample" IS the whole frame in order — bin it directly
+            # instead of paying a second streaming pass
+            Xb[:] = bin_features(full, edges)
+            return y, edges, Xb
+        del sample, full
         off = 0
         for hb in frame.batches(1 << 16, cols=[fcol]):
             x = np.asarray(hb[fcol], np.float32)

@@ -27,7 +27,7 @@ def test_all_examples_present():
     found = sorted(f for f in os.listdir(EXAMPLES_DIR)
                    if f[0].isdigit() and f.endswith(".py"))
     assert [f.split("_")[0] for f in found] == [
-        "101", "102", "103", "201", "202", "301", "302", "303"]
+        "101", "102", "103", "201", "202", "301", "302", "303", "304"]
 
 
 def test_101_census():
@@ -86,3 +86,12 @@ def test_303_transfer_learning():
     out = _run("303_transfer_learning.py")
     assert out["accuracy"] > 0.85  # bright-vs-dark is easy from embeddings
     assert out["embedding_dim"] == 64
+
+
+@pytest.mark.slow
+def test_304_distributed_training():
+    out = _run("304_distributed_training.py")
+    assert set(out) == {0, 1}
+    # one global program: both launcher processes agree exactly
+    assert out[0] == out[1]
+    assert out[0]["accuracy"] > 0.85
