@@ -79,15 +79,21 @@ def main() -> dict:
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for i in range(2)]
     results = {}
-    for i, p in enumerate(procs):
-        out, _ = p.communicate(timeout=600)
-        if p.returncode != 0:
-            raise SystemExit(f"process {i} failed:\n{out[-3000:]}")
-        for line in out.splitlines():
-            if line.startswith("304 "):
-                print(line)
-                parts = dict(kv.split("=") for kv in line.split()[3:])
-                results[i] = {k: float(v) for k, v in parts.items()}
+    try:
+        for i, p in enumerate(procs):
+            out, _ = p.communicate(timeout=600)
+            if p.returncode != 0:
+                raise SystemExit(f"process {i} failed:\n{out[-3000:]}")
+            for line in out.splitlines():
+                if line.startswith("304 "):
+                    print(line)
+                    parts = dict(kv.split("=") for kv in line.split()[3:])
+                    results[i] = {k: float(v) for k, v in parts.items()}
+    finally:
+        for p in procs:  # a failed/hung worker must not orphan its sibling
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     return results
 
 

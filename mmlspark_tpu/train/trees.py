@@ -274,13 +274,16 @@ class _TreeParams(JaxEstimator):
         y = np.concatenate(ys)
         full = np.concatenate(sample) if len(sample) > 1 else sample[0]
         edges = make_bin_edges(full, self.maxBins)
-        Xb = np.empty((n, F), np.uint8)  # maxBins <= 256 -> bins fit uint8
         if take >= 1.0:
-            # the "sample" IS the whole frame in order — bin it directly
-            # instead of paying a second streaming pass
+            # the "sample" IS the whole frame in order (bounded by the
+            # sample cap) — bin it directly, no second streaming pass
+            Xb = np.empty((n, F), np.uint8)
             Xb[:] = bin_features(full, edges)
             return y, edges, Xb
+        # drop the fp32 sample BEFORE allocating the bin matrix: at the
+        # RAM edge the two must not be resident together
         del sample, full
+        Xb = np.empty((n, F), np.uint8)  # maxBins <= 256 -> bins fit uint8
         off = 0
         for hb in frame.batches(1 << 16, cols=[fcol]):
             x = np.asarray(hb[fcol], np.float32)

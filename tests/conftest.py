@@ -38,9 +38,17 @@ def pytest_sessionstart(session):
         # full suite on the ambient backend would fail confusingly at
         # every mesh-shape assumption, so refuse up front
         marker = (session.config.getoption("-m") or "").strip()
-        import re
-        selects_tpu = ("tpu" in re.findall(r"\w+", marker)
-                       and "not tpu" not in marker)
+        # the expression must imply the tpu mark: it selects a plain
+        # tpu-marked item AND rejects an item carrying every mark BUT tpu
+        try:
+            from _pytest.mark.expression import Expression
+            expr = Expression.compile(marker)
+            selects_tpu = (expr.evaluate(lambda name: name == "tpu")
+                           and not expr.evaluate(lambda name: name != "tpu"))
+        except Exception:
+            import re
+            selects_tpu = ("tpu" in re.findall(r"\w+", marker)
+                           and "not tpu" not in marker and "or" not in marker)
         assert selects_tpu, (
             "MMLSPARK_TEST_TPU=1 runs the real-accelerator smoke lane "
             "only: add -m tpu (or use ./tools/runme testtpu), or unset "

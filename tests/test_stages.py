@@ -189,3 +189,36 @@ class TestStageSaveLoad:
             stage.save(p)
             loaded = type(stage).load(p)
             assert loaded.explicit_param_values() == stage.explicit_param_values()
+
+
+def test_checkpoint_data_disk_spill_roundtrip(tmp_path):
+    """diskIncluded=True stages the frame as memory-mapped chunks (the
+    MEMORY_AND_DISK analogue); removeCheckpoint re-materializes."""
+    from mmlspark_tpu.core.disk import DiskFrame
+    from mmlspark_tpu.stages.stages import CheckpointData
+
+    rng = np.random.default_rng(0)
+    f = Frame.from_dict({"x": rng.normal(size=(300, 4)).astype(np.float32),
+                         "y": rng.integers(0, 2, 300)}, num_partitions=3)
+    spilled = CheckpointData(diskIncluded=True,
+                             checkpointDir=str(tmp_path / "ck")).transform(f)
+    assert isinstance(spilled, DiskFrame)
+    assert spilled.count() == 300
+    np.testing.assert_array_equal(
+        np.concatenate([b["x"] for b in spilled.batches(128)]),
+        f.column("x"))
+    back = CheckpointData(removeCheckpoint=True).transform(spilled)
+    assert not isinstance(back, DiskFrame)
+    np.testing.assert_array_equal(back.column("x"), f.column("x"))
+    # a REAL in-memory copy: writable, not a view pinning the chunk files
+    assert back.partitions[0]["x"].flags.writeable
+    # user-provided directory is the user's to manage: still on disk
+    import os
+    assert os.path.exists(str(tmp_path / "ck"))
+
+    # self-created temp staging is reclaimed by removeCheckpoint
+    spilled2 = CheckpointData(diskIncluded=True).transform(f)
+    staged = spilled2._checkpoint_dir
+    assert os.path.exists(staged)
+    CheckpointData(removeCheckpoint=True).transform(spilled2)
+    assert not os.path.exists(staged)
