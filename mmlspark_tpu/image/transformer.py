@@ -10,6 +10,16 @@ UnrollImage converts an image row to a flat float32 vector
 order is HWC (XLA's native NHWC conv layout) rather than the reference's
 CHW, and the uint8->float conversion needs no sign fixup because the bytes
 never pass through a signed JVM byte array.
+
+Placement decision: these stages run on HOST (vectorized numpy, shape-
+grouped batching) because their contract is host-value -> host-value — a
+device round trip per stage would pay host->HBM->host twice for elementwise
+work. The DEVICE versions of the hot path (resize + requantize + normalize)
+live where they can fuse into a consumer's jit instead: JaxModel's
+``devicePreprocess`` / ``ops.pallas_preprocess``, which ImageFeaturizer
+routes uniform uint8 inputs through automatically — same half-pixel and
+uint8-rounding semantics, pinned by tests, so host and device paths are
+interchangeable.
 """
 from __future__ import annotations
 
