@@ -356,3 +356,101 @@ def test_stream_images_drops_undecodable_and_matches_eager(tmp_path, rng):
     for c in chunks:
         for img in c["image"]:
             assert img.data.dtype == np.uint8 and img.data.ndim == 3
+
+
+# -- parquet (Spark's native format) -----------------------------------------
+def test_parquet_roundtrip_and_types(tmp_path, rng):
+    from mmlspark_tpu.io.readers import read_parquet, write_parquet
+    f = Frame.from_dict({
+        "x": np.arange(10.0),
+        "i": np.arange(10, dtype=np.int64),
+        "s": [f"w{i}" for i in range(10)],
+        "v": rng.normal(size=(10, 3)).astype(np.float32),
+        "tok": [["a", "b"], ["c"]] * 5,
+        "raw": [bytes([i]) for i in range(10)],
+    })
+    p = str(tmp_path / "t.parquet")
+    write_parquet(f, p)
+    g = read_parquet(p)
+    assert g.schema["v"].dim == 3
+    assert g.schema["tok"].dtype == DType.TOKENS
+    assert g.schema["raw"].dtype == DType.BINARY
+    np.testing.assert_allclose(g.column("v"), f.column("v"), rtol=1e-6)
+    np.testing.assert_array_equal(g.column("i"), f.column("i"))
+    assert list(g.column("s")) == list(f.column("s"))
+    assert g.column("raw")[3] == b"\x03"
+
+    # column projection
+    sub = read_parquet(p, columns=["x", "s"])
+    assert sub.columns == ["x", "s"]
+
+    # IMAGE columns refuse (not representable)
+    from mmlspark_tpu.core.schema import ColumnSchema as CS, ImageValue
+    imgs = np.empty(2, dtype=object)
+    for i in range(2):
+        imgs[i] = ImageValue(path="m", data=np.zeros((2, 2, 3), np.uint8))
+    fi = Frame.from_dict({"a": [1.0, 2.0]}).with_column_values(
+        CS("image", DType.IMAGE), imgs)
+    with pytest.raises(ValueError, match="IMAGE"):
+        write_parquet(fi, str(tmp_path / "bad.parquet"))
+
+
+def test_parquet_directory_of_parts(tmp_path):
+    from mmlspark_tpu.io.readers import read_parquet, write_parquet
+    d = tmp_path / "dataset"
+    d.mkdir()
+    for i in range(3):
+        part = Frame.from_dict({"x": np.arange(4.0) + 4 * i,
+                                "y": np.full(4, i)})
+        write_parquet(part, str(d / f"part-{i:05d}.parquet"))
+    g = read_parquet(str(d))
+    assert g.count() == 12
+    np.testing.assert_array_equal(np.sort(g.column("x")), np.arange(12.0))
+    # feeds the training path directly
+    from mmlspark_tpu.train.learners import LogisticRegression
+    from mmlspark_tpu.train.train_classifier import TrainClassifier
+    g2 = read_parquet(str(d))
+    model = TrainClassifier(model=LogisticRegression(maxIter=20),
+                            labelCol="y").fit(
+        g2.filter(lambda p: p["y"] < 2))
+    assert model is not None
+
+
+def test_parquet_type_dispatch_edge_cases(tmp_path):
+    """Conversion is driven by the Arrow TYPE: nulls/empties cannot flip a
+    column's meaning; ragged numeric lists refuse instead of corrupting."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from mmlspark_tpu.io.readers import read_parquet
+
+    p = str(tmp_path / "e.parquet")
+    pq.write_table(pa.table({
+        "ragged": pa.array([[1.0, 2.0], None, [3.0]],
+                           type=pa.list_(pa.float64())),
+        "x": pa.array([1.0, 2.0, 3.0])}), p)
+    with pytest.raises(ValueError, match="ragged"):
+        read_parquet(p)
+
+    pq.write_table(pa.table({
+        "tok": pa.array([[], None, ["a"]], type=pa.list_(pa.string())),
+        "x": pa.array([1.0, 2.0, 3.0])}), p)
+    g = read_parquet(p)
+    assert g.schema["tok"].dtype == DType.TOKENS  # empties stay TOKENS
+
+    # empty shard (more hosts than part files) yields a 0-row frame with
+    # the real schema instead of crashing one host
+    d = tmp_path / "parts"
+    d.mkdir()
+    pq.write_table(pa.table({"v": pa.array([[1.0, 2.0]],
+                                           type=pa.list_(pa.float64())),
+                             "y": pa.array([1])}),
+                   str(d / "part-0.parquet"))
+    from mmlspark_tpu.io import readers as _r
+    real = _r._process_slice
+    _r._process_slice = lambda items, shard: []
+    try:
+        empty = read_parquet(str(d), process_shard=True)
+    finally:
+        _r._process_slice = real
+    assert empty.count() == 0
+    assert empty.columns == ["v", "y"]
