@@ -85,7 +85,29 @@ def _encode(obj: Any, arrays: Dict[str, np.ndarray], path: str) -> Any:
         return [_encode(v, arrays, f"{path}/{i}") for i, v in enumerate(obj)]
     if obj is None or isinstance(obj, (bool, int, float, str)):
         return obj
+    mesh_dict = _mesh_to_dict(obj)
+    if mesh_dict is not None:
+        return _encode(mesh_dict, arrays, path)
     raise TypeError(f"cannot serialize {type(obj).__name__} at state path {path!r}")
+
+
+def _mesh_to_dict(obj: Any):
+    """Mesh-shaped param values (DeepClassifier/JaxModel meshSpec) persist
+    as axis-size dicts: a live Mesh is process-bound (its device list has
+    no meaning in another process) and ``resolve_mesh`` accepts the dict
+    back, so save/load round-trips the SHAPE — the portable part.
+    Returns None for non-mesh objects."""
+    try:
+        from dataclasses import asdict
+        from jax.sharding import Mesh
+        from mmlspark_tpu.parallel.mesh import MeshSpec
+    except ImportError:  # pragma: no cover - jax always present here
+        return None
+    if isinstance(obj, MeshSpec):
+        return asdict(obj)
+    if isinstance(obj, Mesh):
+        return {k: int(v) for k, v in obj.shape.items()}
+    return None
 
 
 def _decode(obj: Any, arrays: Dict[str, np.ndarray]) -> Any:
@@ -227,4 +249,7 @@ def _json_fallback(o):
         return float(o)
     if isinstance(o, (np.bool_,)):
         return bool(o)
+    mesh_dict = _mesh_to_dict(o)
+    if mesh_dict is not None:
+        return mesh_dict
     raise TypeError(f"not JSON serializable: {type(o).__name__}")

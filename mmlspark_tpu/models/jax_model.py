@@ -25,7 +25,7 @@ import numpy as np
 
 from mmlspark_tpu.core.frame import Frame
 from mmlspark_tpu.core.params import (
-    DictParam, HasInputCol, HasOutputCol, IntParam, StringParam,
+    AnyParam, DictParam, HasInputCol, HasOutputCol, IntParam, StringParam,
 )
 from mmlspark_tpu.core.pipeline import Model
 from mmlspark_tpu.core.schema import ColumnSchema, DType, SchemaError
@@ -51,6 +51,14 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         "model input ON DEVICE ({} = off). The north-star fusion: raw "
         "uint8 crosses host->HBM, resize+normalize fuse ahead of the "
         "first layer instead of running per-image on the host.", {})
+    meshSpec = AnyParam(
+        "meshSpec", "shard SCORING over a device mesh (MeshSpec / "
+        "axis-size dict / Mesh; None = single-device jit). Params shard "
+        "by the standard rules (tensor/fsdp for the big matmuls) and the "
+        "batch over the data axes — model-parallel inference for nets one "
+        "chip cannot hold, a capability the reference's single-graph "
+        "CNTKModel had no analogue for. Per-host: each process scores its "
+        "own rows on a process-local mesh.", None)
 
     def set_model(self, architecture: str, params: Optional[Any] = None,
                   seed: int = 0, input_mean=None, input_std=None,
@@ -92,6 +100,20 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
     def layer_names(self):
         return list(self._spec()["layer_names"])
 
+    def _resolve_score_mesh(self):
+        """The scoring mesh, or None for the single-device fast path."""
+        if self.get("meshSpec") is None:
+            return None
+        from mmlspark_tpu.parallel.mesh import resolve_mesh
+        from mmlspark_tpu.parallel.sharding import mesh_spans_processes
+        mesh = resolve_mesh(self.get("meshSpec"))
+        if mesh_spans_processes(mesh):
+            raise SchemaError(
+                "JaxModel scoring is per-host (each process scores its own "
+                "rows); use a process-local mesh, not one spanning "
+                "processes")
+        return mesh
+
     def _build_apply(self):
         spec = self._spec()
         module = spec["module"]
@@ -101,6 +123,15 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         # parameter size and multiplies compile time (or overflows
         # remote-compile request limits outright)
         params = jax.tree_util.tree_map(jnp.asarray, self._state["params"])
+        mesh = self._resolve_score_mesh()
+        if mesh is not None:
+            # model-parallel scoring: params land sharded (tensor/fsdp per
+            # the standard rules) ONCE; every batch then streams through
+            # the pjit'd apply with its batch dim over the data axes
+            from mmlspark_tpu.parallel.sharding import param_shardings
+            with mesh:
+                params = jax.device_put(
+                    params, param_shardings(params, mesh))
         node = self.outputNodeName
 
         # Optional input standardization: models trained on z-scored inputs
@@ -139,9 +170,18 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         else:
             pre = base
 
+        def bind(jitted):
+            if mesh is None:
+                return lambda x: jitted(params, x)
+
+            def call(x):
+                with mesh:
+                    return jitted(params, x)
+            return call
+
         if not node:
             jitted = jax.jit(lambda p, x: module.apply(p, pre(x)))
-            return (lambda x: jitted(params, x)), None
+            return bind(jitted), None, mesh
 
         from mmlspark_tpu.models.zoo.resnet import apply_with_intermediates
 
@@ -172,7 +212,7 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
                 raise SchemaError(
                     f"output node {node!r} not found; have {sorted(inters)}")
             return matches[0]
-        return (lambda x: jitted(params, x)), node
+        return bind(jitted), node, mesh
 
     def _coerce_batch(self, arr: np.ndarray, spec) -> np.ndarray:
         """Host-side input coercion (reference UDFs :195-212) + reshape.
@@ -201,12 +241,15 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
 
     def transform(self, frame: Frame) -> Frame:
         spec = self._spec()
-        apply, _ = self._cached_jit(
+        apply, _, mesh = self._cached_jit(
             lambda: self._build_apply(),
             key=(self.architecture, repr(self.get("architectureArgs")),
                  self.outputNodeName, repr(self.get("devicePreprocess")),
+                 repr(self.get("meshSpec")),
                  ))
         bs = self.miniBatchSize
+        if mesh is not None:
+            return self._transform_sharded(frame, spec, apply, mesh, bs)
         # Async scoring loop: a batch's transfer + forward is DISPATCHED
         # before earlier results are fetched (JAX dispatch returns
         # immediately), so host->device DMA overlaps compute instead of the
@@ -262,6 +305,10 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
                 flush()
         flush()
         retire()
+        return self._emit(frame, outs)
+
+    def _emit(self, frame: Frame, outs: list) -> Frame:
+        """Fetched output batches -> the scored frame column."""
         out = np.concatenate(outs, axis=0) if outs \
             else np.zeros((0, 1), np.float32)
         if out.ndim == 1:
@@ -270,6 +317,38 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
                            metadata={"model_uid": self.uid,
                                      "architecture": self.architecture})
         return frame.with_column_values(col, out.astype(np.float32))
+
+    def _transform_sharded(self, frame: Frame, spec, apply, mesh,
+                           bs: int) -> Frame:
+        """Mesh-mode scoring loop: each padded batch is committed with its
+        batch dim over the data axes and runs through the pjit'd apply —
+        the sharded counterpart of the single-device windowed loop (the
+        transfer-batching optimization matters on tunneled single chips;
+        model-parallel scoring targets big models where compute, not the
+        wire, dominates)."""
+        from mmlspark_tpu.parallel.sharding import batch_share, shard_batch
+        _, total = batch_share(mesh)
+        bs = int(np.ceil(bs / total) * total)  # divisible over data axes
+        outs: list = []
+        pending: list = []
+
+        def retire(down_to: int) -> None:
+            while len(pending) > down_to:
+                out, n = pending.pop(0)
+                outs.append(np.asarray(jax.device_get(out))[:n])
+
+        with mesh:
+            for batch in frame.batches(bs, cols=[self.inputCol]):
+                x = self._coerce_batch(batch[self.inputCol], spec)
+                n = x.shape[0]
+                if n < bs:
+                    pad = np.zeros((bs - n,) + x.shape[1:], x.dtype)
+                    x = np.concatenate([x, pad], axis=0)
+                xd = shard_batch(mesh, {"x": x})["x"]
+                pending.append((apply(xd), n))  # async dispatch
+                retire(down_to=8)  # bound outputs resident in HBM
+            retire(down_to=0)
+        return self._emit(frame, outs)
 
     def transform_schema(self, schema):
         return schema.add(ColumnSchema(self.outputCol, DType.VECTOR, None))

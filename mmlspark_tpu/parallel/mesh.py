@@ -106,6 +106,23 @@ def mesh_from_config(devices: Optional[Sequence] = None) -> Mesh:
     return make_mesh(MeshSpec(**parse_mesh_axes(text)), devices)
 
 
+def resolve_mesh(mesh_spec) -> Mesh:
+    """MeshSpec | axis-size dict | Mesh | None -> Mesh. None consults the
+    launcher's ``runtime.mesh`` config (falling back to all-devices data
+    parallel), so ``mmlspark-tpu run train.py --mesh data=2,tensor=4``
+    reshapes TRAINING without touching the script. (JaxModel scoring
+    treats an unset meshSpec as the single-device fast path instead —
+    scoring rarely needs a mesh and must not silently change shape under
+    a launcher flag meant for training.)"""
+    if mesh_spec is None:
+        return mesh_from_config()
+    if isinstance(mesh_spec, Mesh):
+        return mesh_spec
+    if isinstance(mesh_spec, dict):
+        mesh_spec = MeshSpec(**mesh_spec)
+    return make_mesh(mesh_spec)
+
+
 def initialize_multihost(coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
                          process_id: Optional[int] = None) -> None:

@@ -65,7 +65,9 @@ def _path_str(path) -> str:
 
 
 def _fit_spec(spec: P, ndim: int, mesh: Mesh, shape) -> P:
-    """Clamp a rule's PartitionSpec to the array's rank and divisibility."""
+    """Clamp a rule's PartitionSpec to the array's rank and divisibility.
+    Axes the mesh doesn't carry count as size 1 (user-built meshes may
+    name only the axes they use)."""
     entries = list(spec) + [None] * (ndim - len(spec))
     entries = entries[:ndim]
     fixed = []
@@ -73,9 +75,11 @@ def _fit_spec(spec: P, ndim: int, mesh: Mesh, shape) -> P:
         if axis is None:
             fixed.append(None)
             continue
-        size = np.prod([mesh.shape[a] for a in
-                        (axis if isinstance(axis, tuple) else (axis,))])
-        fixed.append(axis if size > 1 and dim % size == 0 else None)
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        size = np.prod([mesh.shape.get(a, 1) for a in axes])
+        present = all(a in mesh.shape for a in axes)
+        fixed.append(axis if present and size > 1 and dim % size == 0
+                     else None)
     return P(*fixed)
 
 

@@ -55,21 +55,8 @@ from mmlspark_tpu.train.learners import (
 
 
 def _resolve_mesh(mesh_spec):
-    """MeshSpec | axis-size dict | Mesh | None -> Mesh. None consults the
-    launcher's ``runtime.mesh`` config (falling back to data-parallel), so
-    ``mmlspark-tpu run train.py --mesh data=2,tensor=4`` reshapes training
-    without touching the script."""
-    from jax.sharding import Mesh
-    from mmlspark_tpu.parallel.mesh import (
-        MeshSpec, make_mesh, mesh_from_config,
-    )
-    if mesh_spec is None:
-        return mesh_from_config()
-    if isinstance(mesh_spec, Mesh):
-        return mesh_spec
-    if isinstance(mesh_spec, dict):
-        mesh_spec = MeshSpec(**mesh_spec)
-    return make_mesh(mesh_spec)
+    from mmlspark_tpu.parallel.mesh import resolve_mesh
+    return resolve_mesh(mesh_spec)
 
 
 def _build_spec(architecture: str, arch_args: Dict[str, Any],
