@@ -106,6 +106,13 @@ def _mesh_to_dict(obj: Any):
     if isinstance(obj, MeshSpec):
         return asdict(obj)
     if isinstance(obj, Mesh):
+        from mmlspark_tpu.parallel.mesh import AXES
+        bad = sorted(set(obj.shape) - set(AXES))
+        if bad:
+            raise TypeError(
+                f"cannot persist a Mesh with non-standard axes {bad}: "
+                f"resolve_mesh could not rebuild it at load; use the "
+                f"standard axis names {AXES}")
         return {k: int(v) for k, v in obj.shape.items()}
     return None
 

@@ -34,6 +34,11 @@ class ImageFeaturizer(HasInputCol, HasOutputCol, Transformer):
         "(0 = head logits, 1 = feature layer)", 1,
         validator=lambda v: v >= 0)
     miniBatchSize = IntParam("miniBatchSize", "scoring batch size", 512)
+    meshSpec = AnyParam(
+        "meshSpec", "shard the scoring net over a device mesh (MeshSpec / "
+        "axis-size dict / Mesh; None = single-device) — model-parallel "
+        "featurization for backbones one chip cannot hold; forwarded to "
+        "the internal JaxModel", None)
 
     def __init__(self, uid=None, **kwargs):
         kwargs.setdefault("inputCol", "image")
@@ -122,13 +127,15 @@ class ImageFeaturizer(HasInputCol, HasOutputCol, Transformer):
         # The scoring JaxModel is cached across transform() calls: a fresh
         # one per call would pay the jit compile (20-40s on TPU) every time.
         key = (self.architecture, repr(self.get("architectureArgs")), node,
-               self.miniBatchSize, repr(device_pre))
+               self.miniBatchSize, repr(device_pre),
+               repr(self.get("meshSpec")))
         jm = getattr(self, "_jm_cache", None)
         if jm is None or getattr(self, "_jm_key", None) != key:
             jm = JaxModel(inputCol=tmp_vec, outputCol=self.outputCol,
                           miniBatchSize=self.miniBatchSize,
                           outputNodeName=node,
-                          devicePreprocess=device_pre)
+                          devicePreprocess=device_pre,
+                          meshSpec=self.get("meshSpec"))
             jm.set_params(architecture=self.architecture,
                           architectureArgs=self.get("architectureArgs"))
             jm._state = {"params": self._state["params"]}

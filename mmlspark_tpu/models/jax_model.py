@@ -337,17 +337,18 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
                 out, n = pending.pop(0)
                 outs.append(np.asarray(jax.device_get(out))[:n])
 
-        with mesh:
-            for batch in frame.batches(bs, cols=[self.inputCol]):
-                x = self._coerce_batch(batch[self.inputCol], spec)
-                n = x.shape[0]
-                if n < bs:
-                    pad = np.zeros((bs - n,) + x.shape[1:], x.dtype)
-                    x = np.concatenate([x, pad], axis=0)
-                xd = shard_batch(mesh, {"x": x})["x"]
-                pending.append((apply(xd), n))  # async dispatch
-                retire(down_to=8)  # bound outputs resident in HBM
-            retire(down_to=0)
+        # no outer mesh context: `apply` is self-contained (bind() enters
+        # the mesh), and device_put/device_get need none
+        for batch in frame.batches(bs, cols=[self.inputCol]):
+            x = self._coerce_batch(batch[self.inputCol], spec)
+            n = x.shape[0]
+            if n < bs:
+                pad = np.zeros((bs - n,) + x.shape[1:], x.dtype)
+                x = np.concatenate([x, pad], axis=0)
+            xd = shard_batch(mesh, {"x": x})["x"]
+            pending.append((apply(xd), n))  # async dispatch
+            retire(down_to=8)  # bound outputs resident in HBM
+        retire(down_to=0)
         return self._emit(frame, outs)
 
     def transform_schema(self, schema):

@@ -119,6 +119,10 @@ def resolve_mesh(mesh_spec) -> Mesh:
     if isinstance(mesh_spec, Mesh):
         return mesh_spec
     if isinstance(mesh_spec, dict):
+        unknown = sorted(set(mesh_spec) - set(AXES))
+        if unknown:
+            raise ValueError(
+                f"unknown mesh axes {unknown}; valid axes are {AXES}")
         mesh_spec = MeshSpec(**mesh_spec)
     return make_mesh(mesh_spec)
 

@@ -454,3 +454,24 @@ def test_parquet_type_dispatch_edge_cases(tmp_path):
         _r._process_slice = real
     assert empty.count() == 0
     assert empty.columns == ["v", "y"]
+
+
+def test_image_featurizer_sharded_scoring_matches(rng):
+    """meshSpec forwards to the internal JaxModel: model-parallel
+    featurization (fused uint8 wire + device resize included) must match
+    single-device embeddings."""
+    f = make_image_frame(rng, n=6, h=20, w=30)  # uniform uint8 -> fused
+    # float32 compute: sharded-vs-single parity is then float-tight (the
+    # bf16 default adds ~1e-2 reduction noise that says nothing here)
+    kw = dict(num_classes=9, image_size=8, patch=4, dtype="float32")
+    plain = ImageFeaturizer(cutOutputLayers=1, miniBatchSize=4)
+    plain.set_model("vit_tiny", seed=0, **kw)
+    ref = np.asarray(plain.transform(f).column("features"))
+
+    sharded = ImageFeaturizer(cutOutputLayers=1, miniBatchSize=4,
+                              meshSpec={"data": 2, "tensor": 4})
+    sharded.set_model("vit_tiny", seed=0, **kw)
+    got = np.asarray(sharded.transform(f).column("features"))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    assert sharded._jm_cache.get("devicePreprocess") == {
+        "srcShape": [20, 30, 3], "resize": [8, 8]}  # fused path + mesh

@@ -313,3 +313,19 @@ def test_jax_model_mesh_spec_save_load_and_bare_mesh(tmp_path):
         assert isinstance(loaded.get("meshSpec"), dict)
         got = np.asarray(loaded.transform(frame).column("o"))
         np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_mesh_persistence_rejects_nonstandard_axes(tmp_path):
+    """A Mesh with axis names resolve_mesh can't rebuild must fail at SAVE
+    with guidance, not load fine and crash at transform."""
+    from jax.sharding import Mesh
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.parallel.mesh import resolve_mesh
+
+    odd = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("data", "model"))
+    m = JaxModel(inputCol="x", outputCol="o", meshSpec=odd)
+    m.set_model("mlp_tabular", input_dim=4, hidden=[8], num_classes=2)
+    with pytest.raises(TypeError, match="non-standard axes"):
+        save_stage(m, str(tmp_path / "m"))
+    with pytest.raises(ValueError, match="unknown mesh axes"):
+        resolve_mesh({"data": 2, "model": 4})
