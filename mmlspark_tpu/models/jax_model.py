@@ -91,10 +91,28 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         return self
 
     # -- internals ---------------------------------------------------------
-    def _spec(self) -> Dict[str, Any]:
+    def _spec(self, mesh=None) -> Dict[str, Any]:
+        """Build the zoo spec; with a ``seq``-parallel scoring mesh, inject
+        the ring/Ulysses attention_fn into builders that accept one — long-
+        context INFERENCE rides the same sequence-parallel machinery as
+        training, chosen by mesh shape rather than serialized state (an
+        attention_fn is process-bound and never persists)."""
         if not self.architecture:
             raise SchemaError("JaxModel: no architecture set; call set_model()")
-        return build_model(self.architecture, **self.get("architectureArgs"))
+        args = dict(self.get("architectureArgs"))
+        spec = build_model(self.architecture, **args)
+        if mesh is not None and mesh.shape.get("seq", 1) > 1 \
+                and "attention_fn" not in args:
+            # OPT-IN per architecture (spec flag), never by signature
+            # sniffing: the ring/Ulysses kernels implement the decoder
+            # (q, k, v, causal) contract — injecting them into, e.g., a
+            # ViT (bidirectional, CLS token making the length odd) would
+            # crash or silently corrupt
+            if spec.get("seq_attention"):
+                from mmlspark_tpu.parallel.sequence import make_attention_fn
+                args["attention_fn"] = make_attention_fn(mesh, "auto")
+                spec = build_model(self.architecture, **args)
+        return spec
 
     @property
     def layer_names(self):
@@ -115,7 +133,8 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         return mesh
 
     def _build_apply(self):
-        spec = self._spec()
+        mesh = self._resolve_score_mesh()
+        spec = self._spec(mesh)
         module = spec["module"]
         # params are ARGUMENTS of the jitted function, never closure
         # captures: closed-over arrays inline into the HLO as constants,
@@ -123,7 +142,6 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         # parameter size and multiplies compile time (or overflows
         # remote-compile request limits outright)
         params = jax.tree_util.tree_map(jnp.asarray, self._state["params"])
-        mesh = self._resolve_score_mesh()
         if mesh is not None:
             # model-parallel scoring: params land sharded (tensor/fsdp per
             # the standard rules) ONCE; every batch then streams through
@@ -337,6 +355,9 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
                 out, n = pending.pop(0)
                 outs.append(np.asarray(jax.device_get(out))[:n])
 
+        # sequence dim (tokens are (B, L)) shards over `seq` when the mesh
+        # has one — context-parallel inference
+        seq_axis = "seq" if mesh.shape.get("seq", 1) > 1 else None
         # no outer mesh context: `apply` is self-contained (bind() enters
         # the mesh), and device_put/device_get need none
         for batch in frame.batches(bs, cols=[self.inputCol]):
@@ -345,7 +366,7 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
             if n < bs:
                 pad = np.zeros((bs - n,) + x.shape[1:], x.dtype)
                 x = np.concatenate([x, pad], axis=0)
-            xd = shard_batch(mesh, {"x": x})["x"]
+            xd = shard_batch(mesh, {"x": x}, seq_axis=seq_axis)["x"]
             pending.append((apply(xd), n))  # async dispatch
             retire(down_to=8)  # bound outputs resident in HBM
         retire(down_to=0)

@@ -111,6 +111,9 @@ def transformer_lm(vocab: int = 32000, dim: int = 512, depth: int = 6,
         input_shape=(max_len,), input_dtype="int32",
         feature_layer="hidden", feature_dim=dim,
         layer_names=["hidden", "logits"],
+        # decoder blocks use the (q, k, v, causal) attention contract, so
+        # the ring/Ulysses kernels can be swapped in for seq-parallel runs
+        seq_attention=True,
     )
 
 
@@ -126,4 +129,7 @@ def transformer_lm_tiny(vocab: int = 256, dim: int = 64, depth: int = 2,
         input_shape=(max_len,), input_dtype="int32",
         feature_layer="hidden", feature_dim=dim,
         layer_names=["hidden", "logits"],
+        # decoder blocks use the (q, k, v, causal) attention contract, so
+        # the ring/Ulysses kernels can be swapped in for seq-parallel runs
+        seq_attention=True,
     )

@@ -36,7 +36,12 @@ DEFAULT_RULES: List[Tuple[str, P]] = [
     (r".*(attention|attn).*out.*kernel", P("tensor", "fsdp")),
     (r".*mlp.*(up|gate|wi|fc1|intermediate).*kernel", P("fsdp", "tensor")),
     (r".*mlp.*(down|wo|fc2|output).*kernel", P("tensor", "fsdp")),
-    (r".*embedding.*", P("tensor", None)),
+    # nn.Embed LEAVES only (path ends in 'embedding'): a trailing-anywhere
+    # match also caught conv kernels under layers NAMED *_embedding (ViT's
+    # patch_embedding/kernel) and sharded their SPATIAL dim over `tensor`
+    # — which XLA's SPMD partitioner has been observed to silently
+    # miscompile on the CPU backend, and would at best buy halo exchanges
+    (r".*embedding$", P("tensor", None)),
     (r".*(head|logits|classifier).*kernel", P("fsdp", "tensor")),
     (r".*kernel", P(None, "fsdp")),   # generic dense/conv: shard last-in dim
     (r".*", P()),                     # everything else replicated

@@ -329,3 +329,44 @@ def test_mesh_persistence_rejects_nonstandard_axes(tmp_path):
         save_stage(m, str(tmp_path / "m"))
     with pytest.raises(ValueError, match="unknown mesh axes"):
         resolve_mesh({"data": 2, "model": 4})
+
+
+def test_jax_model_long_context_sharded_scoring():
+    """A seq axis on the scoring mesh routes attention through the ring/
+    Ulysses kernels (context-parallel inference) and shards the token dim;
+    logits must match full attention on a single device."""
+    from mmlspark_tpu.models.jax_model import JaxModel
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, size=(8, 32), dtype=np.int32)
+    frame = Frame.from_dict({"ids": ids})
+    kw = dict(vocab=256, max_len=32, seed=0)
+
+    plain = JaxModel(inputCol="ids", outputCol="o", miniBatchSize=4)
+    plain.set_model("transformer_lm_tiny", **kw)
+    ref = np.asarray(plain.transform(frame).column("o"))
+
+    sharded = JaxModel(inputCol="ids", outputCol="o", miniBatchSize=4,
+                       meshSpec={"data": 2, "seq": 2, "tensor": 2})
+    sharded.set_model("transformer_lm_tiny", **kw)
+    got = np.asarray(sharded.transform(frame).column("o"))
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_seq_mesh_does_not_inject_attention_into_vit(rng):
+    """The seq-parallel attention injection is opt-in by spec flag: a ViT
+    (bidirectional attention, odd token count) on a seq-carrying mesh must
+    score through its own attention, matching the single-device output."""
+    from mmlspark_tpu.models.jax_model import JaxModel
+
+    X = rng.normal(0, 1, (8, 8 * 8 * 3)).astype(np.float32)
+    frame = Frame.from_dict({"img": X})
+    kw = dict(num_classes=5, image_size=8, patch=4, dtype="float32")
+    plain = JaxModel(inputCol="img", outputCol="o", miniBatchSize=4)
+    plain.set_model("vit_tiny", seed=0, **kw)
+    ref = np.asarray(plain.transform(frame).column("o"))
+    sharded = JaxModel(inputCol="img", outputCol="o", miniBatchSize=4,
+                       meshSpec={"data": 2, "seq": 2, "tensor": 2})
+    sharded.set_model("vit_tiny", seed=0, **kw)
+    got = np.asarray(sharded.transform(frame).column("o"))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)

@@ -244,3 +244,46 @@ def test_graft_entry_forward():
     fn, args = g.entry()
     out = jax.jit(fn)(*args)
     assert out.shape == (8, 10)
+
+
+def test_param_shardings_never_shard_conv_spatial_dims():
+    """Regression: ViT's patch_embedding/kernel (H, W, in, out) matched the
+    embedding rule and got its SPATIAL dim sharded over `tensor`, which
+    the SPMD partitioner silently miscomputed on a data x fsdp x tensor
+    mesh (wrong logits, no error). Conv kernels may shard only their
+    output-features dim; nn.Embed leaves keep their vocab sharding."""
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.models.zoo import build_model
+    from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mmlspark_tpu.parallel.sharding import param_shardings
+
+    mesh = make_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    spec = build_model("vit_tiny", num_classes=5, image_size=8, patch=4)
+    params = spec["module"].init(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8, 8, 3)))
+    shardings = param_shardings(params, mesh)
+
+    def walk(tree, path=""):
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                yield from walk(v, f"{path}/{k}")
+        else:
+            yield path, tree
+
+    leaves = dict(walk(jax.tree_util.tree_map(lambda s: s, shardings)))
+    vals = dict(walk(params))
+    for name, sh in leaves.items():
+        arr = np.asarray(vals[name])
+        if arr.ndim == 4:  # conv kernels (H, W, in, out): spatial dims
+            spatial = list(sh.spec[:2]) if len(sh.spec) else []
+            assert all(a is None for a in spatial), (name, sh.spec)
+
+    # token-embedding matrices still shard their vocab dim over tensor
+    lm = build_model("transformer_lm_tiny", vocab=256, max_len=16)
+    lp = lm["module"].init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 16), jnp.int32))
+    lsh = dict(walk(param_shardings(lp, mesh)))
+    embeds = {n: s for n, s in lsh.items() if n.endswith("embedding")}
+    assert embeds and any(s.spec and s.spec[0] == "tensor"
+                          for s in embeds.values()), embeds
