@@ -210,11 +210,18 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         # Probe (shape-only, no compile) whether the node is an explicitly
         # sown layer; capture_intermediates=True records EVERY submodule
         # output and costs ~3x at runtime, so it is the fallback, not the
-        # default.
+        # default. On a scoring mesh the probe batch must satisfy the
+        # shard_map divisibility of any injected seq-parallel attention
+        # (ring shards the batch over the data axes), so probe with one
+        # row per batch shard instead of one row total.
+        probe_rows = 1
+        if mesh is not None:
+            from mmlspark_tpu.parallel.sharding import batch_share
+            probe_rows = batch_share(mesh)[1]
         if dp:
-            probe_shape = (1, int(np.prod(src)))
+            probe_shape = (probe_rows, int(np.prod(src)))
         else:
-            probe_shape = (1,) + tuple(spec["input_shape"])
+            probe_shape = (probe_rows,) + tuple(spec["input_shape"])
         dt = jnp.int32 if spec.get("input_dtype") == "int32" else jnp.float32
         probe = jax.eval_shape(
             lambda x: apply_with_intermediates(module, params, pre(x))[1],
@@ -355,9 +362,13 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
                 out, n = pending.pop(0)
                 outs.append(np.asarray(jax.device_get(out))[:n])
 
-        # sequence dim (tokens are (B, L)) shards over `seq` when the mesh
-        # has one — context-parallel inference
-        seq_axis = "seq" if mesh.shape.get("seq", 1) > 1 else None
+        # sequence dim (tokens are (B, L)) shards over `seq` only for
+        # architectures that OPTED INTO seq-parallel attention — for
+        # anything else dim 1 is features/spatial, where a seq sharding
+        # would at best crash on divisibility and at worst hit the
+        # spatial-sharding miscompiles the sharding rules guard against
+        seq_axis = ("seq" if mesh.shape.get("seq", 1) > 1
+                    and spec.get("seq_attention") else None)
         # no outer mesh context: `apply` is self-contained (bind() enters
         # the mesh), and device_put/device_get need none
         for batch in frame.batches(bs, cols=[self.inputCol]):

@@ -370,3 +370,54 @@ def test_seq_mesh_does_not_inject_attention_into_vit(rng):
     sharded.set_model("vit_tiny", seed=0, **kw)
     got = np.asarray(sharded.transform(frame).column("o"))
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_sharded_scoring_empty_frame():
+    """0-row frames through the mesh path produce an empty scored column
+    (the single-device loop's contract)."""
+    from mmlspark_tpu.models.jax_model import JaxModel
+    frame = Frame.from_dict({"x": np.zeros((0, 8), np.float32)})
+    m = JaxModel(inputCol="x", outputCol="o", miniBatchSize=4,
+                 meshSpec={"data": -1})
+    m.set_model("mlp_tabular", input_dim=8, hidden=[8], num_classes=2)
+    out = m.transform(frame)
+    assert out.count() == 0
+    assert out.schema["o"].dtype == DType.VECTOR
+
+
+def test_seq_mesh_non_token_models_keep_feature_dim_unsharded(rng):
+    """seq input sharding is gated on the architecture's seq_attention
+    opt-in: an MLP whose feature width does not divide |seq| must still
+    score on a seq-carrying mesh."""
+    from mmlspark_tpu.models.jax_model import JaxModel
+    X = rng.normal(size=(8, 7)).astype(np.float32)  # 7 % seq(2) != 0
+    frame = Frame.from_dict({"x": X})
+    kw = dict(input_dim=7, hidden=[8], num_classes=2, dtype="float32")
+    plain = JaxModel(inputCol="x", outputCol="o", miniBatchSize=4)
+    plain.set_model("mlp_tabular", seed=0, **kw)
+    ref = np.asarray(plain.transform(frame).column("o"))
+    sharded = JaxModel(inputCol="x", outputCol="o", miniBatchSize=4,
+                       meshSpec={"data": 2, "seq": 2, "tensor": 2})
+    sharded.set_model("mlp_tabular", seed=0, **kw)
+    got = np.asarray(sharded.transform(frame).column("o"))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_long_context_feature_extraction_on_seq_mesh(rng):
+    """outputNodeName feature extraction works through the seq-parallel
+    path (the probe batch satisfies ring attention's shard_map
+    divisibility) and matches single-device hidden states."""
+    from mmlspark_tpu.models.jax_model import JaxModel
+    ids = rng.integers(0, 256, size=(8, 32)).astype(np.int32)
+    frame = Frame.from_dict({"ids": ids})
+    kw = dict(vocab=256, max_len=32, seed=0)
+    plain = JaxModel(inputCol="ids", outputCol="h", miniBatchSize=4,
+                     outputNodeName="hidden")
+    plain.set_model("transformer_lm_tiny", **kw)
+    ref = np.asarray(plain.transform(frame).column("h"))
+    sharded = JaxModel(inputCol="ids", outputCol="h", miniBatchSize=4,
+                       outputNodeName="hidden",
+                       meshSpec={"data": 2, "seq": 2, "tensor": 2})
+    sharded.set_model("transformer_lm_tiny", **kw)
+    got = np.asarray(sharded.transform(frame).column("h"))
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
