@@ -144,13 +144,29 @@ def write_frame(source, directory: str, rows_per_chunk: int = 65536,
             n = len(arr) if n is None else n
         chunk_rows.append(int(n or 0))
 
+    # VECTOR storage dtype is decided ONCE per column — from its first
+    # batch — not per batch: a streaming source mixing uint8 and float
+    # batches must not write mixed-dtype chunks (DiskFrame.open bypasses
+    # Frame._unify_vector_dtypes, so mixed chunks would retrace jitted
+    # consumers mid-stream). A later uint8 batch in a float column is
+    # promoted; a later float batch in a uint8 column raises — silent
+    # uint8 quantization of real values is never acceptable.
+    vector_dtypes: Dict[str, np.dtype] = {}
+
     def cast(name: str, arr: np.ndarray) -> np.ndarray:
         """Pin every chunk to ONE storage dtype per column (the invariant
         Frame.__init__._unify_vector_dtypes enforces for in-memory frames;
         mixed chunks would silently retrace jitted consumers mid-stream)."""
         col = schema[name]
         if col.dtype == DType.VECTOR:
-            want = np.uint8 if arr.dtype == np.uint8 else np.float32
+            want = vector_dtypes.setdefault(
+                name, np.dtype(np.uint8 if arr.dtype == np.uint8
+                               else np.float32))
+            if want == np.uint8 and arr.dtype != np.uint8:
+                raise SchemaError(
+                    f"column {name!r} stored as uint8 (from its first "
+                    f"batch) but a later batch has dtype {arr.dtype}; "
+                    f"cast the source to one dtype before write_frame")
             return arr if arr.dtype == want else arr.astype(want)
         want = col.dtype.numpy_dtype
         return arr if arr.dtype == want else arr.astype(want)

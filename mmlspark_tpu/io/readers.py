@@ -237,14 +237,21 @@ def read_csv(path: str, header: bool = True, num_partitions: int = 1,
     if not rows:
         raise ValueError(f"empty csv: {path}")
     names = rows[0] if header else [f"c{i}" for i in range(len(rows[0]))]
-    data_rows = _process_slice(rows[1:] if header else rows, process_shard)
+    data_rows = rows[1:] if header else rows
     cols: dict = {n: [] for n in names}
     for r in data_rows:
         for n, v in zip(names, r):
             cols[n].append(v)
     if infer_types:
+        # Types are inferred from the FULL row set BEFORE the per-process
+        # slice: every host parses the whole file anyway, and slicing first
+        # would let hosts disagree on a column's dtype (int-looking first
+        # half vs fractional second half) — per-host schema divergence in
+        # the SPMD fit this flag exists for.
         for n, vals in cols.items():
             cols[n] = _infer_csv_column(vals)
+    if process_shard:
+        cols = {n: _process_slice(vals, True) for n, vals in cols.items()}
     return Frame.from_dict(cols, num_partitions=num_partitions)
 
 

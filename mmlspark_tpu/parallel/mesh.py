@@ -107,17 +107,20 @@ def mesh_from_config(devices: Optional[Sequence] = None) -> Mesh:
 
 
 def resolve_mesh(mesh_spec) -> Mesh:
-    """MeshSpec | axis-size dict | Mesh | None -> Mesh. None consults the
-    launcher's ``runtime.mesh`` config (falling back to all-devices data
-    parallel), so ``mmlspark-tpu run train.py --mesh data=2,tensor=4``
-    reshapes TRAINING without touching the script. (JaxModel scoring
-    treats an unset meshSpec as the single-device fast path instead —
-    scoring rarely needs a mesh and must not silently change shape under
-    a launcher flag meant for training.)"""
+    """MeshSpec | axis-size dict | "data=2,tensor=4" string | Mesh | None
+    -> Mesh. None consults the launcher's ``runtime.mesh`` config (falling
+    back to all-devices data parallel), so ``mmlspark-tpu run train.py
+    --mesh data=2,tensor=4`` reshapes TRAINING without touching the
+    script; the string form is the same syntax as that flag. (JaxModel
+    scoring treats an unset meshSpec as the single-device fast path
+    instead — scoring rarely needs a mesh and must not silently change
+    shape under a launcher flag meant for training.)"""
     if mesh_spec is None:
         return mesh_from_config()
     if isinstance(mesh_spec, Mesh):
         return mesh_spec
+    if isinstance(mesh_spec, str):
+        mesh_spec = parse_mesh_axes(mesh_spec)
     if isinstance(mesh_spec, dict):
         unknown = sorted(set(mesh_spec) - set(AXES))
         if unknown:

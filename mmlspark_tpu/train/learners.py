@@ -207,8 +207,22 @@ def _epoch_device_cache(frame: Frame, fcol: str, lcol: str, batch_size: int,
         "x": np.broadcast_to(np.float32(0), (padded, d)),
         "y": np.broadcast_to(np.zeros((), y_dtype), (padded,)),
         "w": np.broadcast_to(np.float32(0), (padded,))}
-    if not force and not DeviceEpochCache.fits(stand_in, shuffle=shuffle):
-        return None
+    if not force:
+        fits = DeviceEpochCache.fits(stand_in, shuffle=shuffle)
+        from mmlspark_tpu.parallel.sharding import mesh_spans_processes
+        if mesh is not None and mesh_spans_processes(mesh):
+            # The verdict must be a GLOBAL decision: each process evaluated
+            # fits() on its local padded shard against its local budget, and
+            # near the boundary (or with heterogeneous hosts) they can
+            # disagree — one running the cached program while another
+            # streams means mismatched collectives (hang) or divergent
+            # epoch permutations. AND-reduce, like _allreduce_moments.
+            from jax.experimental import multihost_utils
+            verdicts = np.asarray(multihost_utils.process_allgather(
+                np.asarray([1.0 if fits else 0.0])))
+            fits = bool(verdicts.min() > 0.5)
+        if not fits:
+            return None
     x = np.asarray(frame.column(fcol), np.float32)
     y = np.asarray(frame.column(lcol))
     epoch = dict(zip(("x", "y", "w"),

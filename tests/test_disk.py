@@ -80,6 +80,31 @@ def test_chunks_pinned_to_schema_dtype_and_ragged_rejected(tmp_path):
                     str(tmp_path / "df2"), schema=schema)
 
 
+def test_vector_storage_dtype_pinned_per_column(tmp_path):
+    """The VECTOR storage dtype is decided by the FIRST batch, per column —
+    not re-decided per batch. uint8-first + float-later must raise (silent
+    uint8 quantization), float-first + uint8-later promotes."""
+    schema = Schema([ColumnSchema("x", DType.VECTOR, 2)])
+
+    def float_then_uint8():
+        yield {"x": np.full((3, 2), 0.5, np.float32)}
+        yield {"x": np.full((3, 2), 7, np.uint8)}
+
+    write_frame(float_then_uint8(), str(tmp_path / "df"), rows_per_chunk=2,
+                schema=schema)
+    df = DiskFrame.open(str(tmp_path / "df"))
+    for b in df.batches(2):
+        assert b["x"].dtype == np.float32
+
+    def uint8_then_float():
+        yield {"x": np.full((3, 2), 7, np.uint8)}
+        yield {"x": np.full((3, 2), 0.5, np.float32)}
+
+    with pytest.raises(SchemaError, match="stored as uint8"):
+        write_frame(uint8_then_float(), str(tmp_path / "df2"),
+                    rows_per_chunk=2, schema=schema)
+
+
 def test_validation_split_refuses_disk_frame(tmp_path):
     from mmlspark_tpu.train.deep import DeepClassifier
     f = _frame(n=200)

@@ -89,6 +89,27 @@ def test_read_csv(tmp_path):
     assert f.column("s").tolist() == ["x", "y"]
 
 
+def test_read_csv_process_shard_types_from_full_rows(tmp_path, monkeypatch):
+    """Type inference must see the FULL row set before the per-host slice:
+    a column whose first half is integral and second half fractional must
+    come out float64 on EVERY host (per-host dtype divergence would compile
+    different SPMD programs per process)."""
+    import jax
+    p = tmp_path / "t.csv"
+    rows = [f"{i},row{i}" for i in range(4)] + \
+           [f"{i}.5,row{i}" for i in range(4, 8)]
+    p.write_text("v,s\n" + "\n".join(rows) + "\n")
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    slices = {}
+    for pid in range(2):
+        monkeypatch.setattr(jax, "process_index", lambda pid=pid: pid)
+        f = read_csv(str(p), process_shard=True)
+        assert f.schema["v"].dtype == DType.FLOAT64, f"host {pid} diverged"
+        slices[pid] = f.column("v")
+    full = np.concatenate([slices[0], slices[1]])
+    np.testing.assert_allclose(full, [0, 1, 2, 3, 4.5, 5.5, 6.5, 7.5])
+
+
 # -- image ops ---------------------------------------------------------------
 def test_resize_shapes_and_identity(rng):
     img = rand_img(rng, 16, 8)

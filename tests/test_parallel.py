@@ -189,6 +189,44 @@ def test_device_epoch_cache_shuffle_deterministic_and_complete():
     np.testing.assert_array_equal(epoch_rows(c1, 0), e0)
 
 
+def test_epoch_cache_auto_mode_is_a_global_decision(monkeypatch):
+    """deviceCache='auto' on a process-spanning mesh: each host's local
+    fits() verdict is AND-reduced — if ANY process can't cache, nobody
+    does (a split decision means mismatched collectives / divergent epoch
+    permutations)."""
+    from mmlspark_tpu.core.frame import Frame
+    from mmlspark_tpu.train.learners import _epoch_device_cache
+    import mmlspark_tpu.parallel.sharding as sharding_mod
+    from jax.experimental import multihost_utils
+
+    frame = Frame.from_dict({
+        "features": np.zeros((32, 4), np.float32),
+        "label": np.zeros(32, np.int32)})
+    mesh = data_parallel_mesh()
+    monkeypatch.setattr(sharding_mod, "mesh_spans_processes",
+                        lambda m: True)
+
+    gathered = []
+
+    def fake_allgather(arr):
+        gathered.append(np.asarray(arr))
+        return np.stack([np.asarray(arr), np.asarray([0.0])])  # peer says no
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", fake_allgather)
+    cache = _epoch_device_cache(frame, "features", "label", 8, np.int32,
+                                mesh=mesh)
+    assert cache is None          # local fits=True, peer vetoed
+    assert gathered and gathered[0][0] == 1.0   # local verdict was yes
+
+    # unanimous yes -> cache builds
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda arr: np.stack([np.asarray(arr), np.asarray([1.0])]))
+    cache = _epoch_device_cache(frame, "features", "label", 8, np.int32,
+                                mesh=mesh)
+    assert cache is not None
+
+
 def test_device_epoch_cache_drops_tail_and_checks_budget():
     from mmlspark_tpu.parallel.trainer import DeviceEpochCache
     x = np.arange(21, dtype=np.float32).reshape(21, 1)
