@@ -354,6 +354,32 @@ class Frame:
             parts.append({n: p[n][mask] for n in self.schema.names})
         return Frame(self.schema, parts)
 
+    def random_split(self, weights: Sequence[float],
+                     seed: int = 0) -> List["Frame"]:
+        """Split rows into disjoint Frames with expected proportions
+        ``weights`` — Spark's ``DataFrame.randomSplit``, which the
+        reference's benchmark harness uses for its 60/40 train/test split
+        (``VerifyTrainClassifier.scala:548-551``). Seeded per-row uniforms
+        against the cumulative normalized weights, so every row lands in
+        exactly one split and the same seed reproduces the partition."""
+        w = np.asarray(list(weights), np.float64)
+        if len(w) < 2 or not np.all(w > 0):   # catches NaN too
+            raise ValueError(f"weights must be >=2 positive values, got "
+                             f"{list(weights)}")
+        edges = np.r_[0.0, np.cumsum(w) / w.sum()]
+        edges[-1] = 1.0 + 1e-9          # a u of exactly 1.0 still lands
+        first = self.schema.names[0]
+        us = [np.random.default_rng((int(seed), i)).uniform(
+                  size=len(p[first]))
+              for i, p in enumerate(self.partitions)]
+        out = []
+        for lo, hi in zip(edges[:-1], edges[1:]):
+            parts = [{n: p[n][(u >= lo) & (u < hi)]
+                      for n in self.schema.names}
+                     for p, u in zip(self.partitions, us)]
+            out.append(Frame(self.schema, parts))
+        return out
+
     def na_drop(self, cols: Optional[Sequence[str]] = None) -> "Frame":
         """Drop rows with None/NaN in any of ``cols`` (default: all columns)."""
         cols = list(cols) if cols is not None else self.schema.names

@@ -31,8 +31,11 @@ from mmlspark_tpu.core.serialization import register_stage
 # Spark-metric spellings (reference :26-37)
 MSE, RMSE, R2, MAE = "mse", "rmse", "r2", "mae"
 AUC, ACCURACY, PRECISION, RECALL = "AUC", "accuracy", "precision", "recall"
+AUC_PR = "AUC_PR"
 ALL_METRICS = "all"
-CLASSIFICATION_METRICS = [ACCURACY, PRECISION, RECALL, AUC]
+CLASSIFICATION_METRICS = [ACCURACY, PRECISION, RECALL, AUC, AUC_PR,
+                          "weighted_precision", "weighted_recall",
+                          "weighted_f1"]
 REGRESSION_METRICS = [MSE, RMSE, R2, MAE]
 
 
@@ -65,6 +68,29 @@ def auc_from_roc(curve: np.ndarray) -> float:
     return float(np.trapezoid(curve[:, 1], curve[:, 0]))
 
 
+def pr_curve(labels: np.ndarray, scores: np.ndarray) -> np.ndarray:
+    """Binary precision-recall curve points (recall, precision) by
+    descending threshold, with the (0, 1) anchor Spark's
+    BinaryClassificationMetrics prepends — its areaUnderPR is the
+    benchmark-pinned second metric column (benchmarkMetrics.csv)."""
+    order = np.argsort(-scores, kind="stable")
+    labels = labels[order]
+    tps = np.cumsum(labels)
+    fps = np.cumsum(1 - labels)
+    P = max(tps[-1] if len(tps) else 0, 1)
+    distinct = np.r_[np.nonzero(np.diff(scores[order]))[0], len(labels) - 1] \
+        if len(labels) else np.array([], dtype=int)
+    recall = np.r_[0.0, tps[distinct] / P]
+    with np.errstate(divide="ignore", invalid="ignore"):
+        prec = np.r_[1.0, tps[distinct] /
+                     np.maximum(tps[distinct] + fps[distinct], 1)]
+    return np.stack([recall, prec], axis=1)
+
+
+# same trapezoid over (x, y) points; distinct name kept for call-site clarity
+auc_from_pr = auc_from_roc
+
+
 def confusion_matrix(y: np.ndarray, pred: np.ndarray, k: int) -> np.ndarray:
     cm = np.zeros((k, k), dtype=np.int64)
     np.add.at(cm, (y.astype(int), pred.astype(int)), 1)
@@ -93,12 +119,22 @@ def multiclass_metrics(cm: np.ndarray) -> Dict[str, float]:
         per_prec = np.where(tp + fp > 0, tp / (tp + fp), 0.0)
         per_rec = np.where(tp + fn > 0, tp / (tp + fn), 0.0)
     micro = float(tp.sum() / total) if total else 0.0
+    # support-weighted averages — Spark MulticlassMetrics.weightedFMeasure,
+    # the second benchmark-pinned column for multiclass datasets
+    support = cm.sum(axis=1).astype(np.float64)
+    wts = support / total if total else support
+    with np.errstate(divide="ignore", invalid="ignore"):
+        per_f1 = np.where(per_prec + per_rec > 0,
+                          2 * per_prec * per_rec / (per_prec + per_rec), 0.0)
     return {
         "average_accuracy": float(((tp + tn) / total).mean()) if total else 0.0,
         "macro_averaged_precision": float(per_prec.mean()),
         "macro_averaged_recall": float(per_rec.mean()),
         "micro_averaged_precision": micro,
         "micro_averaged_recall": micro,
+        "weighted_precision": float((per_prec * wts).sum()),
+        "weighted_recall": float((per_rec * wts).sum()),
+        "weighted_f1": float((per_f1 * wts).sum()),
         ACCURACY: micro,
     }
 
@@ -185,6 +221,8 @@ class ComputeModelStatistics(Transformer):
                 curve = roc_curve(y, pos.astype(np.float64))
                 self.roc_curve = curve
                 metrics[AUC] = auc_from_roc(curve)
+                metrics[AUC_PR] = auc_from_pr(
+                    pr_curve(y, pos.astype(np.float64)))
         else:
             mc = multiclass_metrics(cm)
             metrics.update(mc)

@@ -234,7 +234,9 @@ def _epoch_device_cache(frame: Frame, fcol: str, lcol: str, batch_size: int,
 
 def _stream_adam(loss_fn: Callable, params: Any, frame: Frame,
                  fcol: str, lcol: str, *, lr: float, max_steps: int,
-                 batch_size: int, y_dtype=np.int32, seed: int = 0) -> Any:
+                 batch_size: int, y_dtype=np.int32, seed: int = 0,
+                 prox: Optional[Callable] = None,
+                 opt: Optional[optax.GradientTransformation] = None) -> Any:
     """Minibatch Adam streamed from the frame: ONE compiled step shape,
     epochs cycled until ``max_steps`` optimizer steps have run.
 
@@ -252,14 +254,17 @@ def _stream_adam(loss_fn: Callable, params: Any, frame: Frame,
     (the data-parallel path is DeepClassifier); the cache mesh is pinned to
     one device so the plain-jit step sees uncommitted-compatible inputs.
     """
-    opt = optax.adam(lr)
+    opt = optax.adam(lr) if opt is None else opt
     opt_state = opt.init(params)
 
     @jax.jit
     def step(p, s, x, y, w):
         loss, g = jax.value_and_grad(loss_fn)(p, x, y, w)
         updates, s = opt.update(g, s, p)
-        return optax.apply_updates(p, updates), s, loss
+        p = optax.apply_updates(p, updates)
+        # proximal operator after the smooth step (e.g. L1 soft-threshold
+        # for elastic-net LR) — non-smooth penalties don't belong in grad
+        return (prox(p) if prox is not None else p), s, loss
 
     from jax.sharding import Mesh
     # local_devices, not devices: under a multi-process launch the global
@@ -306,12 +311,21 @@ def _stream_adam(loss_fn: Callable, params: Any, frame: Frame,
 class LogisticRegression(HasBatchSize, JaxEstimator):
     """Multinomial logistic regression trained by streamed minibatch Adam.
 
-    Epochs are shuffled, the step compiles at one shape, and L2
-    regularization applies to the weights. ``maxIter`` counts minibatch
-    optimizer steps, not full-dataset passes."""
+    Epochs are shuffled, the step compiles at one shape, and the Spark
+    elastic-net objective applies to the weights
+    (``regParam * (elasticNetParam*||w||_1 + (1-elasticNetParam)/2*||w||_2^2)``,
+    intercept unregularized, features standardized — the objective Spark
+    ML's LogisticRegression minimizes, so a converged fit lands on the
+    same convex optimum the reference's benchmark numbers came from).
+    The L1 part runs as a proximal soft-threshold after each Adam step.
+    ``maxIter`` counts minibatch optimizer steps, not full-dataset
+    passes."""
 
     maxIter = IntParam("maxIter", "number of minibatch optimizer steps", 200)
-    regParam = FloatParam("regParam", "L2 regularization strength", 1e-4)
+    regParam = FloatParam("regParam", "regularization strength", 1e-4)
+    elasticNetParam = FloatParam(
+        "elasticNetParam", "L1 ratio in [0,1]: 0 = pure L2, 1 = pure L1",
+        0.0, validator=lambda v: 0.0 <= v <= 1.0)
     learningRate = FloatParam("learningRate", "Adam learning rate", 0.1)
 
     def fit(self, frame: Frame) -> "LinearClassifierModel":
@@ -320,19 +334,40 @@ class LogisticRegression(HasBatchSize, JaxEstimator):
 
         params = {"w": jnp.zeros((d, n_classes), jnp.float32),
                   "b": jnp.zeros((n_classes,), jnp.float32)}
-        reg = self.regParam
+        alpha = float(self.elasticNetParam)
+        l1 = float(self.regParam) * alpha
+        l2 = float(self.regParam) * (1.0 - alpha) / 2.0
         mu_d, sigma_d = jnp.asarray(mu), jnp.asarray(sigma)
 
         def loss(p, X, y, w):
             logits = ((X - mu_d) / sigma_d) @ p["w"] + p["b"]
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
             return (ce * w).sum() / jnp.maximum(w.sum(), 1.0) \
-                + reg * (p["w"] ** 2).sum()
+                + l2 * (p["w"] ** 2).sum()
+
+        prox = opt = None
+        if l1 > 0:
+            # proximal SGD, not Adam: the soft-threshold lr*l1 only matches
+            # the smooth step when the step is lr*gradient — Adam's
+            # per-coordinate normalization drives every consistent
+            # gradient to a ~lr step, so under it L1 can't zero weak
+            # features and the fit misses the elastic-net optimum Spark's
+            # OWL-QN reaches. learningRate stays the knob, but note SGD on
+            # the standardized logistic loss wants ~0.5 where Adam wants
+            # ~0.1.
+            sgd_lr = float(self.learningRate)
+            opt = optax.sgd(sgd_lr)
+            shrink = jnp.float32(sgd_lr * l1)
+
+            def prox(p):
+                w = p["w"]
+                return {**p, "w": jnp.sign(w)
+                        * jnp.maximum(jnp.abs(w) - shrink, 0.0)}
 
         params = _stream_adam(loss, params, frame, self.featuresCol,
                               self.labelCol, lr=self.learningRate,
                               max_steps=self.maxIter,
-                              batch_size=self.batchSize)
+                              batch_size=self.batchSize, prox=prox, opt=opt)
         model = LinearClassifierModel(featuresCol=self.featuresCol,
                                       labelCol=self.labelCol)
         model._state = {"w": np.asarray(params["w"]), "b": np.asarray(params["b"]),

