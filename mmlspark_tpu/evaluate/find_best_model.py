@@ -25,7 +25,8 @@ from mmlspark_tpu.evaluate.compute_model_statistics import (
 )
 
 LOWER_IS_BETTER = {MSE, RMSE, MAE}
-HIGHER_IS_BETTER = {ACCURACY, PRECISION, RECALL, AUC, R2}
+HIGHER_IS_BETTER = {ACCURACY, PRECISION, RECALL, AUC, R2, "AUC_PR",
+                    "weighted_precision", "weighted_recall", "weighted_f1"}
 
 
 @register_stage

@@ -59,6 +59,14 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         "chip cannot hold, a capability the reference's single-graph "
         "CNTKModel had no analogue for. Per-host: each process scores its "
         "own rows on a process-local mesh.", None)
+    deviceCache = StringParam(
+        "deviceCache", "keep the coerced input resident in HBM across "
+        "transform calls and slice batches on device: 'auto' caches when "
+        "it fits runtime.device_cache_mb, 'on' forces, 'off' streams. "
+        "Repeat scoring of the same frame (FindBestModel candidates, "
+        "evaluation passes) then transfers the input ONCE — the "
+        "inference face of DeviceEpochCache.", "auto",
+        domain=("auto", "on", "off"))
 
     def set_model(self, architecture: str, params: Optional[Any] = None,
                   seed: int = 0, input_mean=None, input_std=None,
@@ -275,6 +283,10 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         bs = self.miniBatchSize
         if mesh is not None:
             return self._transform_sharded(frame, spec, apply, mesh, bs)
+        if self.get("deviceCache") != "off" and frame.count():
+            dev = self._resident_input(frame, spec, bs)
+            if dev is not None:
+                return self._transform_resident(frame, apply, dev, bs)
         # Async scoring loop: a batch's transfer + forward is DISPATCHED
         # before earlier results are fetched (JAX dispatch returns
         # immediately), so host->device DMA overlaps compute instead of the
@@ -329,6 +341,61 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
             if len(pending) >= put_window:
                 flush()
         flush()
+        retire()
+        return self._emit(frame, outs)
+
+    def _resident_input(self, frame: Frame, spec, bs: int):
+        """The frame's coerced input as a device-resident (steps, bs, ...)
+        stack shared across transform calls (and across models with the
+        same coercion — the FindBestModel case), or None when over budget
+        with deviceCache='auto'."""
+        from mmlspark_tpu.models import residency
+        # everything that shapes the coerced stack is part of the key:
+        # input_shape drives _coerce_batch's reshape, so two models with
+        # different input shapes must not share an upload (architecture
+        # itself stays OUT — identical-input models sharing is the point)
+        fingerprint = (self.inputCol, bs, spec.get("input_dtype"),
+                       tuple(spec["input_shape"]),
+                       repr(self.get("devicePreprocess")))
+
+        def build() -> np.ndarray:
+            stacked = []
+            for batch in frame.batches(bs, cols=[self.inputCol]):
+                x = self._coerce_batch(batch[self.inputCol], spec)
+                if x.shape[0] < bs:
+                    pad = np.zeros((bs - x.shape[0],) + x.shape[1:], x.dtype)
+                    x = np.concatenate([x, pad], axis=0)
+                stacked.append(x)
+            return np.stack(stacked)
+
+        return residency.resident_batches(
+            frame, fingerprint, build,
+            force=self.get("deviceCache") == "on")
+
+    def _transform_resident(self, frame: Frame, apply, dev, bs: int) -> Frame:
+        """Score from the resident stack: every batch is a device-side
+        slice of ``dev`` — zero steady-state host->HBM transfer, the same
+        retire-window discipline as the streaming loop."""
+        window, in_flight = 32, 8
+        n_total = frame.count()
+        dev_outs: list = []
+        outs: list = []
+
+        def retire():
+            if not dev_outs:
+                return
+            stacked = dev_outs[0] if len(dev_outs) == 1 \
+                else jnp.concatenate(dev_outs, axis=0)
+            outs.append(np.asarray(jax.device_get(stacked)))
+            dev_outs.clear()
+
+        for i in range(dev.shape[0]):
+            n = min(bs, n_total - i * bs)
+            dev_outs.append(apply(dev[i])[:n])
+            if len(dev_outs) >= window:
+                retire()
+            elif len(dev_outs) >= in_flight:
+                dev_outs[-in_flight].block_until_ready()
         retire()
         return self._emit(frame, outs)
 

@@ -666,6 +666,32 @@ def _pad_rows(x: np.ndarray, bs: int) -> np.ndarray:
     return np.concatenate([x, np.zeros((bs - k,) + x.shape[1:], x.dtype)])
 
 
+def _device_feature_batches(model, frame: Frame, bs: int):
+    """Iterate (device_batch, valid_rows) for scoring. The coerced padded
+    feature batches go through the residency registry, so re-scoring the
+    SAME frame — K FindBestModel candidates, repeated evaluation passes —
+    transfers the features to HBM once and slices on device; an
+    over-budget frame streams a put per batch as before."""
+    from mmlspark_tpu.models import residency
+    n_rows = frame.count()
+
+    def build() -> np.ndarray:
+        return np.stack([
+            _pad_rows(np.asarray(b[model.featuresCol], np.float32), bs)
+            for b in frame.batches(bs, cols=[model.featuresCol])])
+
+    dev = residency.resident_batches(
+        frame, (model.featuresCol, bs, "learner-f32"), build) \
+        if n_rows else None
+    if dev is not None:
+        for i in range(dev.shape[0]):
+            yield dev[i], min(bs, n_rows - i * bs)
+        return
+    for batch in frame.batches(bs, cols=[model.featuresCol]):
+        x = np.asarray(batch[model.featuresCol], dtype=np.float32)
+        yield jnp.asarray(_pad_rows(x, bs)), x.shape[0]
+
+
 def _score_classifier(model, frame: Frame, batch_size: int = 65536) -> Frame:
     """Append prediction / raw scores / probabilities columns.
 
@@ -678,10 +704,8 @@ def _score_classifier(model, frame: Frame, batch_size: int = 65536) -> Frame:
     n_rows = frame.count()
     bs = min(batch_size, max(n_rows, 1))
     preds, scores, probs = [], [], []
-    for batch in frame.batches(bs, cols=[model.featuresCol]):
-        x = np.asarray(batch[model.featuresCol], dtype=np.float32)
-        k = x.shape[0]
-        logits, p = f(jnp.asarray(_pad_rows(x, bs)))
+    for x, k in _device_feature_batches(model, frame, bs):
+        logits, p = f(x)
         preds.append(np.asarray(jnp.argmax(logits, axis=-1))[:k])
         scores.append(np.asarray(logits)[:k])
         probs.append(np.asarray(p)[:k])
@@ -702,10 +726,8 @@ def _score_regressor(model, frame: Frame, batch_size: int = 65536) -> Frame:
     n_rows = frame.count()
     bs = min(batch_size, max(n_rows, 1))
     preds = []
-    for batch in frame.batches(bs, cols=[model.featuresCol]):
-        x = np.asarray(batch[model.featuresCol], dtype=np.float32)
-        k = x.shape[0]
-        preds.append(np.asarray(f(jnp.asarray(_pad_rows(x, bs))))[:k])
+    for x, k in _device_feature_batches(model, frame, bs):
+        preds.append(np.asarray(f(x))[:k])
     pred = np.concatenate(preds) if preds else np.zeros(0, np.float64)
     return frame.with_column_values(
         ColumnSchema("prediction", DType.FLOAT64), pred.astype(np.float64))

@@ -56,6 +56,25 @@ def test_find_best_model_ranks():
     assert "scored_labels" in out.columns
 
 
+def test_find_best_model_scores_candidates_from_one_upload():
+    """K candidates sharing a featurize pass score from ONE device-resident
+    feature upload (CNTKModel.scala:50-104 re-streamed per pass;
+    FindBestModel.scala:135-143 re-scored per candidate)."""
+    from mmlspark_tpu.models import residency
+    frame = make_census_like(n=200)
+    cands = [TrainClassifier(model=LogisticRegression(maxIter=it, learningRate=lr),
+                             labelCol="income").fit(frame)
+             for it, lr in ((1, 1e-6), (40, 0.1), (150, 0.1))]
+    residency.clear()
+    fbm = FindBestModel(models=cands, evaluationMetric="AUC").fit(frame)
+    assert fbm.get("bestModel").uid != cands[0].uid   # crippled one loses
+    assert fbm._state["best_metric"] > 0.8
+    # one shared featurized frame -> one upload across all three scoring
+    # passes (fit-time scoring of every candidate)
+    assert residency.stats()["total_uploads"] == 1
+    residency.clear()
+
+
 def test_find_best_model_validation():
     frame = make_census_like(n=60)
     with pytest.raises(ValueError):

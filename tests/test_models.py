@@ -87,14 +87,65 @@ def test_jax_model_minibatch_padding_consistency():
 
 def test_jax_model_many_batches_crosses_put_windows():
     """Scoring with dozens of minibatches (several transfer windows + an
-    output-retire window + a padded tail) must equal single-batch scoring."""
+    output-retire window + a padded tail) must equal single-batch scoring.
+    deviceCache off: this covers the STREAMING loop's windowing."""
     f = make_image_frame(n=83)  # 42 batches of 2: crosses put_window=8 x5
-    small = JaxModel(inputCol="img", outputCol="o", miniBatchSize=2)
+    small = JaxModel(inputCol="img", outputCol="o", miniBatchSize=2,
+                     deviceCache="off")
     small.set_model("vit_tiny", num_classes=4, image_size=8, patch=4, seed=1)
-    big = JaxModel(inputCol="img", outputCol="o", miniBatchSize=128)
+    big = JaxModel(inputCol="img", outputCol="o", miniBatchSize=128,
+                   deviceCache="off")
     big.set_model("vit_tiny", num_classes=4, image_size=8, patch=4, seed=1)
     np.testing.assert_allclose(small.transform(f).column("o"),
                                big.transform(f).column("o"), atol=2e-2)
+
+
+def test_jax_model_device_cache_matches_streaming_and_reuses_upload():
+    """deviceCache='on': one HBM upload serves repeated transforms (and a
+    40-batch pass crossing retire windows), results identical to the
+    streaming loop; a NEW frame evicts the old residency."""
+    from mmlspark_tpu.models import residency
+    residency.clear()
+    f = make_image_frame(n=83)
+    res = JaxModel(inputCol="img", outputCol="o", miniBatchSize=2,
+                   deviceCache="on")
+    res.set_model("vit_tiny", num_classes=4, image_size=8, patch=4, seed=1)
+    stream = JaxModel(inputCol="img", outputCol="o", miniBatchSize=2,
+                      deviceCache="off")
+    stream.set_model("vit_tiny", num_classes=4, image_size=8, patch=4, seed=1)
+    a = res.transform(f)
+    assert residency.stats()["total_uploads"] == 1
+    a2 = res.transform(f)
+    assert residency.stats()["total_uploads"] == 1  # reused
+    np.testing.assert_allclose(a.column("o"), a2.column("o"))
+    np.testing.assert_allclose(a.column("o"), stream.transform(f).column("o"),
+                               atol=2e-2)
+    f2 = make_image_frame(n=9)
+    res.transform(f2)
+    assert residency.stats()["frames"] == 1  # f evicted, f2 resident
+    residency.clear()
+
+
+def test_jax_model_device_cache_auto_respects_budget():
+    """'auto' under a tiny budget falls back to streaming (no upload) and
+    still scores correctly."""
+    from mmlspark_tpu.models import residency
+    from mmlspark_tpu.utils import config
+    residency.clear()
+    f = make_image_frame(n=12)
+    m = JaxModel(inputCol="img", outputCol="o", miniBatchSize=4)
+    m.set_model("vit_tiny", num_classes=4, image_size=8, patch=4, seed=1)
+    config.set("runtime.device_cache_mb", 1e-6)
+    try:
+        out = m.transform(f)
+        assert residency.stats()["total_uploads"] == 0
+    finally:
+        config.unset("runtime.device_cache_mb")
+    assert out.count() == 12
+    out2 = m.transform(f)   # default budget: now resident
+    assert residency.stats()["total_uploads"] == 1
+    np.testing.assert_allclose(out.column("o"), out2.column("o"))
+    residency.clear()
 
 
 def test_jax_model_output_node_selection():
