@@ -128,25 +128,50 @@ DEADLINE_S = 50.0
 BUDGET_S = 480.0
 
 
-def _best_round_robin(*runs, trials: int = TRIALS,
-                      deadline_s: float = DEADLINE_S):
-    """Best-of-k for N timed regions, interleaved round-robin per trial
-    (a, b, c, a, b, c, ...). The tunnel's effective bandwidth drifts on a
-    seconds-to-minutes scale, so timing one side to completion and then the
-    other can hand either side a 2x handicap; adjacent runs see the same
-    conditions and the best-time RATIOS stay honest. One shared framework
-    timing serves every baseline comparison — N+1 runs per trial instead
-    of 2N."""
-    best = [float("inf")] * len(runs)
+def _robin_rounds(*runs, trials: int = TRIALS,
+                  deadline_s: float = DEADLINE_S):
+    """Per-round times for N timed regions, interleaved round-robin per
+    trial (a, b, c, a, b, c, ...). The tunnel's effective bandwidth drifts
+    on a seconds-to-minutes scale, so timing one side to completion and
+    then the other can hand either side a 2x handicap; adjacent runs see
+    the same conditions. Returning every round (not just the best) lets
+    ratios be computed WITHIN rounds and medianed across them — a ratio
+    of two bests taken in different bandwidth windows is exactly the
+    artifact this exists to kill."""
+    rounds = []
     start = time.perf_counter()
     for r in range(trials):
-        for i, run in enumerate(runs):
+        ts = [0.0] * len(runs)
+        # rotate the order each round: the tunnel keeps per-connection
+        # state (window/latency) for ~100 ms after heavy activity, so
+        # whoever runs right after the heavy streaming baseline measures
+        # ~40 ms faster — a fixed order turns that into a systematic bias
+        # on the ratios, rotation averages it out
+        for k in range(len(runs)):
+            i = (r + k) % len(runs)
             t0 = time.perf_counter()
-            run()
-            best[i] = min(best[i], time.perf_counter() - t0)
+            runs[i]()
+            ts[i] = time.perf_counter() - t0
+        rounds.append(ts)
         if r >= 1 and time.perf_counter() - start > deadline_s:
             break
-    return best
+    return rounds
+
+
+def _best(rounds, i: int = 0) -> float:
+    return min(t[i] for t in rounds)
+
+
+def _med_ratio(rounds, num: int, den: int) -> float:
+    """Median across rounds of t[num]/t[den] — the robust speedup of
+    region ``den`` over region ``num`` under drifting link conditions."""
+    return float(np.median([t[num] / t[den] for t in rounds]))
+
+
+def _best_round_robin(*runs, trials: int = TRIALS,
+                      deadline_s: float = DEADLINE_S):
+    rounds = _robin_rounds(*runs, trials=trials, deadline_s=deadline_s)
+    return [_best(rounds, i) for i in range(len(runs))]
 
 
 def _best_pair(run_fw, run_base, trials: int = TRIALS):
@@ -315,34 +340,119 @@ def make_resident_jax_run(images: np.ndarray, labels: np.ndarray):
     return run, flops
 
 
+def _train_parity(images: np.ndarray, labels: np.ndarray,
+                  steps: int = 60) -> dict:
+    """Same-seed, same-batch-order N-step train on BOTH paths; the final
+    losses must agree. A framework bug that silently degraded convergence
+    (wrong preprocess constants, a dropped gradient, an SPMD miscompile)
+    moves this field while leaving every throughput number untouched —
+    the accuracy-parity gate BASELINE.json's 'top-1 acc parity' metric
+    asks for."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from mmlspark_tpu.ops.pallas_preprocess import make_preprocess_fn
+    from mmlspark_tpu.parallel.trainer import DeviceEpochCache, DistributedTrainer
+
+    module = _build_model()
+    pre = make_preprocess_fn(IMAGE_SHAPE, mean=MEAN, std=STD)
+    trainer = DistributedTrainer(_loss_builder(module, pre),
+                                 optax.sgd(0.1, momentum=0.9))
+    state = trainer.init(
+        lambda: module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1,) + IMAGE_SHAPE, jnp.float32)))
+    rng = jax.random.PRNGKey(1)
+    cache = DeviceEpochCache(
+        {"image": images.astype(np.uint8), "label": labels.astype(np.int32)},
+        BATCH, mesh=trainer.mesh)
+
+    def fw_losses():
+        nonlocal state
+        done, losses = 0, []
+        while done < steps:
+            for batch in cache.batches(0):   # epoch 0 order, no shuffle
+                state, metrics = trainer.train_step(state, batch, rng)
+                losses.append(metrics["loss"])
+                done += 1
+                if done >= steps:
+                    break
+        return float(jax.device_get(losses[-1]))
+
+    # pure-JAX twin: identical init seed, identical ordered batches
+    mean = jnp.asarray(np.array(MEAN, np.float32))
+    std = jnp.asarray(np.array(STD, np.float32))
+    opt = optax.sgd(0.1, momentum=0.9)
+
+    def loss_fn(params, x_u8, y):
+        x = (x_u8.reshape((-1,) + IMAGE_SHAPE).astype(jnp.float32)
+             - mean) / std
+        logits = module.apply(params, x.astype(jnp.bfloat16)).astype(jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1,) + IMAGE_SHAPE, jnp.float32))
+    opt_state = opt.init(params)
+    n = images.shape[0] // BATCH * BATCH
+    loss = None
+    done = 0
+    while done < steps:
+        for off in range(0, n, BATCH):
+            params, opt_state, loss = step(
+                params, opt_state, jnp.asarray(images[off:off + BATCH]),
+                jnp.asarray(labels[off:off + BATCH]))
+            done += 1
+            if done >= steps:
+                break
+    fw_loss = fw_losses()
+    base_loss = float(jax.device_get(loss))
+    denom = max(abs(base_loss), 1e-9)
+    return {"steps": steps,
+            "framework_loss": round(fw_loss, 5),
+            "pure_jax_loss": round(base_loss, 5),
+            "rel_diff": round(abs(fw_loss - base_loss) / denom, 5)}
+
+
 def config_train() -> dict:
     images, labels = _make_data(n_rows=4096)
     run_fw = make_framework_run(images, labels)
     run_base = make_pure_jax_run(images, labels)
     run_res, flops = make_resident_jax_run(images, labels)
-    t_fw, t_base, t_res = _best_round_robin(run_fw, run_base, run_res)
+    rounds = _robin_rounds(run_fw, run_base, run_res)
+    t_fw = _best(rounds, 0)
     fw_ips = STEPS * BATCH / t_fw
-    base_ips = STEPS * BATCH / t_base
-    res_ips = STEPS * BATCH / t_res
     tflops, mfu = _mfu(fw_ips, flops, BATCH)
     return {"value": round(fw_ips, 2), "unit": "images/sec/chip",
-            "vs_baseline": round(fw_ips / base_ips, 4),
+            "vs_baseline": round(_med_ratio(rounds, 1, 0), 4),
             # framework overhead vs a baseline that ALSO keeps the epoch on
             # device (>= 0.90 is the honest north-star reading)
-            "vs_resident_baseline": round(fw_ips / res_ips, 4),
+            "vs_resident_baseline": round(_med_ratio(rounds, 2, 0), 4),
             "step_ms": round(t_fw / STEPS * 1e3, 3),
-            "achieved_tflops": tflops, "mfu": mfu}
+            "achieved_tflops": tflops, "mfu": mfu,
+            "loss_parity": _train_parity(images, labels)}
 
 
 # -- config "eval": JaxModel minibatch scoring (CNTKModel parity) ------------
 
 def config_eval() -> dict:
     """CNTKModel-parity minibatch scoring. The framework scores the raw
-    uint8 image column — its wire format keeps uint8 across host->HBM (1/4
-    the bytes) and casts on device, where the reference marshaled fp32
-    FloatVectorVectors (``CNTKModel.scala:63-78``). The baseline is the
-    conventional inline loop: fp32 tensors, one put + apply + get per
-    batch. Same model, same rows, same outputs."""
+    uint8 image column with deviceCache residency: the coerced input went
+    to HBM once (warmup), every later pass slices on device and retires
+    outputs in windows — where the reference re-marshaled fp32
+    FloatVectorVectors per pass (``CNTKModel.scala:63-78``).
+
+    Two baselines, interleaved with the framework run:
+    - vs_baseline: the conventional inline loop (fp32 tensors, one put +
+      apply + sync get per batch) — what a user would write first;
+    - vs_resident_baseline: the SAME residency the framework enjoys
+      (uint8 batches pre-staged on device, async dispatch, one fetch) —
+      the ratio is pure framework overhead (emit, slicing, bookkeeping),
+      the >= 0.90 target."""
     import jax
     import jax.numpy as jnp
     from mmlspark_tpu.core.frame import Frame
@@ -353,13 +463,13 @@ def config_eval() -> dict:
     images, _ = _make_data(n_rows=n, seed=1)
     feats = images.astype(np.float32)
 
-    jm = JaxModel(inputCol="features", outputCol="scored", miniBatchSize=bs)
+    jm = JaxModel(inputCol="features", outputCol="scored", miniBatchSize=bs,
+                  deviceCache="on")
     jm.set_model("resnet20_cifar", num_classes=10, seed=0)
     frame = Frame.from_dict({"features": images}, num_partitions=8)
 
-    jm.transform(frame)  # warmup: compile + one full pass
+    jm.transform(frame)  # warmup: compile + the one residency upload
 
-    # baseline: bare jit apply over numpy slices, same sync pattern
     spec = build_model("resnet20_cifar", num_classes=10)
     module = spec["module"]
     params = module.init(jax.random.PRNGKey(0),
@@ -375,15 +485,29 @@ def config_eval() -> dict:
             outs.append(np.asarray(jax.device_get(y)))
         return outs
 
+    # residency-matched baseline: uint8 resident, cast on device (the
+    # framework's exact dtype discipline), all applies dispatched async,
+    # one concat + fetch — the fastest honest hand-written equivalent
+    u4 = images.reshape((-1,) + IMAGE_SHAPE)
+    dev_u8 = [jnp.asarray(u4[off:off + bs]) for off in range(0, n, bs)]
+    jax.block_until_ready(dev_u8)
+    jit_u8 = jax.jit(lambda p, x: module.apply(p, x.astype(jnp.float32)))
+
+    def run_res():
+        outs = [jit_u8(params, x) for x in dev_u8]
+        return np.asarray(jax.device_get(jnp.concatenate(outs, axis=0)))
+
     run_base()
-    t_fw, t_base = _best_pair(lambda: jm.transform(frame), run_base,
-                              trials=5)
-    fw_ips, base_ips = n / t_fw, n / t_base
+    run_res()
+    rounds = _robin_rounds(lambda: jm.transform(frame), run_base, run_res)
+    t_fw = _best(rounds, 0)
+    fw_ips = n / t_fw
     flops = _step_flops(jitted, params,
                         jnp.zeros((bs,) + IMAGE_SHAPE, jnp.float32))
     tflops, mfu = _mfu(fw_ips, flops, bs)
     return {"value": round(fw_ips, 2), "unit": "images/sec/chip",
-            "vs_baseline": round(fw_ips / base_ips, 4),
+            "vs_baseline": round(_med_ratio(rounds, 1, 0), 4),
+            "vs_resident_baseline": round(_med_ratio(rounds, 2, 0), 4),
             "step_ms": round(t_fw / (n / bs) * 1e3, 3),
             "achieved_tflops": tflops, "mfu": mfu}
 
@@ -411,11 +535,13 @@ def config_image_featurize() -> dict:
                          cutOutputLayers=1, miniBatchSize=bs)
     fz.set_model("resnet50", num_classes=1000, seed=0)
 
-    fz.transform(frame)  # warmup
-    # TIMED fw side: resize 256->224 + unroll + pool-layer scoring
+    fz.transform(frame)  # warmup: compile + unroll memo + residency upload
+    # TIMED fw side after warmup: device resize 256->224 fused into the
+    # pool-layer scoring jit, inputs already HBM-resident
 
-    # baseline: the bare ResNet-50 forward on pre-prepared fp32 tensors —
-    # the ratio exposes what the featurization pipeline costs on top
+    # conventional baseline: the bare ResNet-50 forward on pre-prepared
+    # fp32 tensors, one put + sync get per batch — what replacing the
+    # featurizer with a hand loop would look like
     spec = build_model("resnet50", num_classes=1000)
     module = spec["module"]
     params = module.init(jax.random.PRNGKey(0),
@@ -428,15 +554,39 @@ def config_image_featurize() -> dict:
         for off in range(0, n, bs):
             jax.device_get(apply(jnp.asarray(pre[off:off + bs])))
 
+    # residency-matched baseline: the SAME resident raw-uint8 input the
+    # framework scores from, through a hand-written device resize +
+    # pool-feature extraction (the featurizer's actual job — emitting
+    # logits would fetch half the bytes and flatter the baseline), async
+    # dispatch, one fetch — the ratio is framework bookkeeping only
+    from mmlspark_tpu.models.zoo.resnet import apply_with_intermediates
+    from mmlspark_tpu.ops.pallas_preprocess import device_resize_bilinear
+    dev_u8 = [jnp.asarray(raw[off:off + bs]) for off in range(0, n, bs)]
+    jax.block_until_ready(dev_u8)
+
+    @jax.jit
+    def res_jit(p, xu8):
+        x = device_resize_bilinear(xu8.astype(jnp.float32), dst, dst)
+        x = jnp.clip(jnp.round(x), 0.0, 255.0)   # featurizer's requantize
+        _, inters = apply_with_intermediates(module, p, x)
+        return [v for k, v in sorted(inters.items())
+                if k.endswith("pool")][0]
+
+    def run_res():
+        outs = [res_jit(params, x) for x in dev_u8]
+        return jax.device_get(jnp.concatenate(outs, axis=0))
+
     run_base()
-    t_fw, t_base = _best_pair(lambda: fz.transform(frame), run_base,
-                              trials=5)
-    fw_ips, base_ips = n / t_fw, n / t_base
+    run_res()
+    rounds = _robin_rounds(lambda: fz.transform(frame), run_base, run_res)
+    t_fw = _best(rounds, 0)
+    fw_ips = n / t_fw
     flops = _step_flops(jitted, params,
                         jnp.zeros((bs, dst, dst, 3), jnp.float32))
     tflops, mfu = _mfu(fw_ips, flops, bs)
     return {"value": round(fw_ips, 2), "unit": "images/sec/chip",
-            "vs_baseline": round(fw_ips / base_ips, 4),
+            "vs_baseline": round(_med_ratio(rounds, 1, 0), 4),
+            "vs_resident_baseline": round(_med_ratio(rounds, 2, 0), 4),
             "step_ms": round(t_fw / (n / bs) * 1e3, 3),
             "achieved_tflops": tflops, "mfu": mfu}
 
@@ -565,9 +715,36 @@ def config_text() -> dict:
                     rng)
         jax.block_until_ready(metrics["loss"])
 
-    t_fw, t_base = _best_pair(run_fw, run_base)
+    # residency-matched baseline: same tokenize+hash, then hand-staged
+    # resident batches re-used across the epochs (the framework does the
+    # same through DeviceEpochCache — the ratio isolates the cache's
+    # construction/bookkeeping overhead)
+    module_r, trainer_r = _textcnn_trainer()
+    state_r = trainer_r.init(
+        lambda: module_r.init(jax.random.PRNGKey(0),
+                              jnp.zeros((1, _SEQ_LEN), jnp.int32)))
+    for _ in range(WARMUP):
+        state_r, metrics = trainer_r.train_step(
+            state_r, trainer_r.put_batch(
+                {"ids": warm_ids, "label": labels[:BATCH]}), rng)
+    jax.block_until_ready(metrics["loss"])
+
+    def run_res():
+        nonlocal state_r
+        ids = _tokenize_hash(texts)
+        resident = [trainer_r.put_batch(
+            {"ids": ids[s * BATCH:(s + 1) * BATCH],
+             "label": labels[s * BATCH:(s + 1) * BATCH]})
+            for s in range(_TEXT_STEPS)]
+        for _ in range(_TEXT_EPOCHS):
+            for batch in resident:
+                state_r, metrics = trainer_r.train_step(state_r, batch, rng)
+        jax.block_until_ready(metrics["loss"])
+
+    rounds = _robin_rounds(run_fw, run_base, run_res)
+    t_fw = _best(rounds, 0)
     rows = n * _TEXT_EPOCHS
-    fw_rps, base_rps = rows / t_fw, rows / t_base
+    fw_rps = rows / t_fw
     flops = 0.0
     if trainer._train_step is not None:
         flops = _step_flops(
@@ -576,7 +753,8 @@ def config_text() -> dict:
             rng)
     tflops, mfu = _mfu(fw_rps, flops, BATCH)
     return {"value": round(fw_rps, 2), "unit": "rows/sec/chip",
-            "vs_baseline": round(fw_rps / base_rps, 4),
+            "vs_baseline": round(_med_ratio(rounds, 1, 0), 4),
+            "vs_resident_baseline": round(_med_ratio(rounds, 2, 0), 4),
             "step_ms": round(t_fw / (_TEXT_EPOCHS * _TEXT_STEPS) * 1e3, 3),
             "achieved_tflops": tflops, "mfu": mfu}
 
@@ -584,25 +762,32 @@ def config_text() -> dict:
 # -- config "vit_preprocess": fused Pallas uint8 pipe into ViT-B/16 ----------
 
 def config_vit_preprocess() -> dict:
+    """The full BASELINE.json config 5: ImageTransformer's crop+normalize
+    rewritten as ONE Pallas kernel fused into the ViT-B/16 featurizer —
+    raw 256x256 uint8 crosses the wire, center-crop to 224 + requantize +
+    normalize run as two MXU matmuls + a VPU pass emitting bf16 straight
+    into the patch embedding."""
     import jax
     import jax.numpy as jnp
     from mmlspark_tpu.models.zoo import build_model
-    from mmlspark_tpu.ops.pallas_preprocess import make_preprocess_fn
+    from mmlspark_tpu.ops.pallas_preprocess import make_fused_preprocess_fn
 
-    size, bs, steps = 224, 32, 8
+    src, size, bs, steps = 256, 224, 32, 8
     shape = (size, size, 3)
-    n_pix = int(np.prod(shape))
     rng = np.random.default_rng(4)
-    u8 = rng.integers(0, 256, size=(bs, n_pix), dtype=np.uint8)
+    u8 = rng.integers(0, 256, size=(bs, src * src * 3), dtype=np.uint8)
 
     spec = build_model("vit_b16", num_classes=1000)
     module = spec["module"]
     params = module.init(jax.random.PRNGKey(0),
                          jnp.zeros((1,) + shape, jnp.float32))
 
-    # framework path: uint8 crosses the wire; Pallas normalize fuses into
-    # the SAME jit as the ViT forward (no fp32 image HBM round trip)
-    pre = make_preprocess_fn(shape, mean=(127.5,) * 3, std=(127.5,) * 3)
+    # framework path: uint8 crosses the wire; the fused Pallas
+    # crop+normalize kernel feeds the ViT forward inside ONE jit (no fp32
+    # image HBM round trip, no host preprocessing)
+    pre = make_fused_preprocess_fn((src, src, 3), crop=(size, size),
+                                   mean=(127.5,) * 3, std=(127.5,) * 3,
+                                   out_dtype=jnp.bfloat16)
 
     @jax.jit
     def fused_jit(p, u8_flat):
@@ -619,8 +804,11 @@ def config_vit_preprocess() -> dict:
 
     run_fused()
 
-    # baseline: conventional unfused pipeline — normalize on host in fp32
-    # (the OpenCV-style CPU preprocess), ship 4x the bytes, then forward
+    # baseline: conventional unfused pipeline — crop + normalize on host
+    # in fp32 (the OpenCV-style CPU preprocess), ship 4x the bytes, then
+    # forward
+    off = (src - size) // 2
+
     @jax.jit
     def forward_jit(p, x):
         return module.apply(p, x.astype(jnp.bfloat16))
@@ -631,18 +819,50 @@ def config_vit_preprocess() -> dict:
     def run_unfused():
         out = None
         for _ in range(steps):
-            x = (u8.astype(np.float32) - 127.5) / 127.5
-            out = forward(jnp.asarray(x.reshape((bs,) + shape)))
+            img = u8.reshape(bs, src, src, 3)[:, off:off + size,
+                                              off:off + size]
+            x = (img.astype(np.float32) - 127.5) / 127.5
+            out = forward(jnp.asarray(x))
+        jax.block_until_ready(out)
+
+    # residency-matched baseline: the SAME resident uint8 input through a
+    # plain-XLA crop+normalize (jnp ops the compiler fuses itself) +
+    # forward — the ratio isolates what the framework's Pallas kernel adds
+    # or costs relative to letting XLA do the fusion, with the wire out of
+    # the picture on both sides
+    dev_u8 = jnp.asarray(u8)
+    jax.block_until_ready(dev_u8)
+
+    @jax.jit
+    def xla_jit(p, xu8):
+        img = xu8.reshape(bs, src, src, 3)[:, off:off + size,
+                                           off:off + size]
+        x = (img.astype(jnp.float32) - 127.5) / 127.5
+        return module.apply(p, x.astype(jnp.bfloat16))
+
+    def run_fused_res():
+        out = None
+        for _ in range(steps):
+            out = fused_jit(params, dev_u8)
+        jax.block_until_ready(out)
+
+    def run_res():
+        out = None
+        for _ in range(steps):
+            out = xla_jit(params, dev_u8)
         jax.block_until_ready(out)
 
     run_unfused()
-    t_fw, t_base = _best_pair(run_fused, run_unfused, trials=5)
+    run_res()
+    run_fused_res()
+    rounds = _robin_rounds(run_fused, run_unfused, run_fused_res, run_res)
+    t_fw = _best(rounds, 0)
     fw_ips = steps * bs / t_fw
-    base_ips = steps * bs / t_base
     flops = _step_flops(fused_jit, params, jnp.asarray(u8))
     tflops, mfu = _mfu(fw_ips, flops, bs)
     return {"value": round(fw_ips, 2), "unit": "images/sec/chip",
-            "vs_baseline": round(fw_ips / base_ips, 4),
+            "vs_baseline": round(_med_ratio(rounds, 1, 0), 4),
+            "vs_resident_baseline": round(_med_ratio(rounds, 3, 2), 4),
             "step_ms": round(t_fw / steps * 1e3, 3),
             "achieved_tflops": tflops, "mfu": mfu}
 

@@ -20,13 +20,14 @@ from mmlspark_tpu.core.params import AnyParam, StringParam
 from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
 from mmlspark_tpu.core.serialization import register_stage
 from mmlspark_tpu.evaluate.compute_model_statistics import (
-    ACCURACY, AUC, ALL_METRICS, MAE, MSE, PRECISION, R2, RECALL, RMSE,
-    ComputeModelStatistics,
+    ACCURACY, AUC, ALL_METRICS, CLASSIFICATION_METRICS, MAE, MSE, PRECISION,
+    R2, RECALL, RMSE, ComputeModelStatistics,
 )
 
 LOWER_IS_BETTER = {MSE, RMSE, MAE}
-HIGHER_IS_BETTER = {ACCURACY, PRECISION, RECALL, AUC, R2, "AUC_PR",
-                    "weighted_precision", "weighted_recall", "weighted_f1"}
+# derived, not hand-listed: a metric added to the evaluator must be
+# rankable here without anyone remembering a second list
+HIGHER_IS_BETTER = set(CLASSIFICATION_METRICS) | {R2}
 
 
 @register_stage

@@ -23,6 +23,17 @@ from mmlspark_tpu.image.transformer import ImageTransformer, UnrollImage
 from mmlspark_tpu.models.jax_model import JaxModel
 from mmlspark_tpu.models.zoo import build_model
 
+# input frame -> {prep fingerprint: unrolled frame}. Repeat featurization
+# of the SAME frame (transfer-learning fit loops, benchmark trials)
+# re-did the host resize/unroll AND produced a fresh intermediate frame,
+# which also defeated JaxModel's deviceCache (keyed on frame identity).
+# Memoizing the prepared frame makes the second pass pure compute: host
+# prep skipped, device upload reused. Weak keys: the memo dies with the
+# input frame, like models/residency.
+import weakref  # noqa: E402
+
+_PREP_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
 
 @register_stage
 class ImageFeaturizer(HasInputCol, HasOutputCol, Transformer):
@@ -88,42 +99,61 @@ class ImageFeaturizer(HasInputCol, HasOutputCol, Transformer):
 
         tmp_vec = frame.schema.find_unused_name("_unrolled")
         in_dtype = frame.schema[self.inputCol].dtype
-        # Fast path — the north-star fusion: when the column holds uniform
-        # uint8 HWC images, skip the host resize entirely. Raw uint8 crosses
-        # host->HBM (1/4 the bytes of fp32) and reshape+bilinear-resize run
-        # ON DEVICE fused into the scoring jit, ahead of the first conv.
-        # One pass collects (shape, dtype); the result also answers the
-        # general path's wire-format question (binary input decodes to
-        # uint8, so only float IMAGE values force the float32 unroll).
-        variants = ({(v.data.shape, v.data.dtype) for p in frame.partitions
-                     for v in p[self.inputCol]}
-                    if in_dtype == DType.IMAGE else set())
-        all_u8 = (in_dtype != DType.IMAGE
-                  or all(dt == np.dtype(np.uint8) for _, dt in variants))
-        fused = (len(variants) == 1 and all_u8
-                 and len(next(iter(variants))[0]) == 3
-                 and next(iter(variants))[0][2] == in_shape[2])
-        device_pre = {}
-        if fused:
-            src_shape = next(iter(variants))[0]
-            unrolled = UnrollImage(inputCol=self.inputCol, outputCol=tmp_vec,
-                                   outputDtype="uint8").transform(frame)
-            device_pre = {"srcShape": [int(v) for v in src_shape],
-                          "resize": [int(in_shape[0]), int(in_shape[1])]}
+        prep_key = (self.inputCol, tuple(int(v) for v in in_shape))
+        entry = _PREP_CACHE.get(frame)
+        if entry is not None and prep_key in entry:
+            unrolled, device_pre = entry[prep_key]
         else:
-            # General path: ragged sizes / float data / gray images resize
-            # on host (batched by shape group), then unroll.
-            tmp_img = frame.schema.find_unused_name("_resized")
-            resized = ImageTransformer(inputCol=self.inputCol,
-                                       outputCol=tmp_img) \
-                .resize(in_shape[0], in_shape[1]).transform(frame)
-            # uint8 wire format when the data allows it: 4x less host->HBM
-            # traffic; JaxModel casts to float on device. Float image data
-            # (user-built ImageValue) keeps the lossless float32 unroll.
-            unrolled = UnrollImage(
-                inputCol=tmp_img, outputCol=tmp_vec,
-                outputDtype="uint8" if all_u8 else "float32") \
-                .transform(resized).drop(tmp_img)
+            # Fast path — the north-star fusion: when the column holds
+            # uniform uint8 HWC images, skip the host resize entirely. Raw
+            # uint8 crosses host->HBM (1/4 the bytes of fp32) and
+            # reshape+bilinear-resize run ON DEVICE fused into the scoring
+            # jit, ahead of the first conv. One pass collects
+            # (shape, dtype); the result also answers the general path's
+            # wire-format question (binary input decodes to uint8, so only
+            # float IMAGE values force the float32 unroll). The scan (and
+            # everything after it) runs once per frame: the memo key only
+            # needs the input column and target shape.
+            variants = ({(v.data.shape, v.data.dtype)
+                         for p in frame.partitions
+                         for v in p[self.inputCol]}
+                        if in_dtype == DType.IMAGE else set())
+            all_u8 = (in_dtype != DType.IMAGE
+                      or all(dt == np.dtype(np.uint8) for _, dt in variants))
+            fused = (len(variants) == 1 and all_u8
+                     and len(next(iter(variants))[0]) == 3
+                     and next(iter(variants))[0][2] == in_shape[2])
+            device_pre = {}
+            if fused:
+                src_shape = next(iter(variants))[0]
+                unrolled = UnrollImage(inputCol=self.inputCol,
+                                       outputCol=tmp_vec,
+                                       outputDtype="uint8").transform(frame)
+                device_pre = {"srcShape": [int(v) for v in src_shape],
+                              "resize": [int(in_shape[0]), int(in_shape[1])]}
+            else:
+                # General path: ragged sizes / float data / gray images
+                # resize on host (batched by shape group), then unroll.
+                tmp_img = frame.schema.find_unused_name("_resized")
+                resized = ImageTransformer(inputCol=self.inputCol,
+                                           outputCol=tmp_img) \
+                    .resize(in_shape[0], in_shape[1]).transform(frame)
+                # uint8 wire format when the data allows it: 4x less
+                # host->HBM traffic; JaxModel casts to float on device.
+                # Float image data (user-built ImageValue) keeps the
+                # lossless float32 unroll.
+                unrolled = UnrollImage(
+                    inputCol=tmp_img, outputCol=tmp_vec,
+                    outputDtype="uint8" if all_u8 else "float32") \
+                    .transform(resized).drop(tmp_img)
+            if entry is None:
+                # single-frame policy (same as models/residency): a NEW
+                # frame evicts other frames' memoized unrolls, bounding
+                # host RAM at ~one unrolled dataset, not one per frame
+                # ever featurized
+                _PREP_CACHE.clear()
+                entry = _PREP_CACHE.setdefault(frame, {})
+            entry[prep_key] = (unrolled, device_pre)
         # The scoring JaxModel is cached across transform() calls: a fresh
         # one per call would pay the jit compile (20-40s on TPU) every time.
         key = (self.architecture, repr(self.get("architectureArgs")), node,

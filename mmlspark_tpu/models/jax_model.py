@@ -46,11 +46,13 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         "outputNodeName", "layer to emit ('' = final output)", "")
     devicePreprocess = DictParam(
         "devicePreprocess", "on-device input preprocessing fused into the "
-        "scoring jit: {'srcShape': [h, w, c], 'resize': [H, W]} reshapes "
-        "the flat wire vector to srcShape and bilinear-resizes it to the "
-        "model input ON DEVICE ({} = off). The north-star fusion: raw "
-        "uint8 crosses host->HBM, resize+normalize fuse ahead of the "
-        "first layer instead of running per-image on the host.", {})
+        "scoring jit: {'srcShape': [h, w, c], 'crop': [ch, cw], "
+        "'resize': [H, W]} reshapes the flat wire vector to srcShape, "
+        "center-crops, and bilinear-resizes to the model input ON DEVICE "
+        "({} = off; crop/resize each optional). The north-star fusion: "
+        "raw uint8 crosses host->HBM and crop+resize+normalize run as "
+        "ONE Pallas kernel ahead of the first layer instead of per-image "
+        "on the host.", {})
     meshSpec = AnyParam(
         "meshSpec", "shard SCORING over a device mesh (MeshSpec / "
         "axis-size dict / Mesh; None = single-device jit). Params shard "
@@ -165,18 +167,39 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         # the same distribution the net was trained on. Shapes must broadcast
         # against the model input shape.
         dp = self.get("devicePreprocess")
+        mu = self._state.get("input_mu")
         if dp:
             src = tuple(int(v) for v in dp["srcShape"])
             dst = tuple(int(v) for v in dp.get("resize") or ())
+            crop = tuple(int(v) for v in dp.get("crop") or ()) or None
 
             from mmlspark_tpu.ops.pallas_preprocess import (
-                device_resize_bilinear,
+                device_resize_bilinear, make_fused_preprocess_fn,
             )
+
+            # scalar / per-channel normalization folds INTO the Pallas
+            # kernel; anything wider (a full-image mean) can't ride its
+            # per-row constants and takes the jnp path below
+            mean_a = (np.asarray(mu, np.float32).ravel()
+                      if mu is not None else np.zeros(1, np.float32))
+            std_a = (np.asarray(self._state["input_sigma"],
+                                np.float32).ravel()
+                     if mu is not None else np.ones(1, np.float32))
+            foldable = mean_a.size in (1, src[2]) \
+                and std_a.size in (1, src[2])
+            fused = make_fused_preprocess_fn(
+                src, resize=dst or None, crop=crop,
+                mean=mean_a, std=std_a,
+                out_dtype=jnp.float32) if foldable else None
 
             def base(x):
                 was_u8 = x.dtype == jnp.uint8
                 x = _to_float(x.reshape((x.shape[0],) + src))
-                if dst and dst != src[:2]:
+                if crop:
+                    oh = (src[0] - crop[0]) // 2
+                    ow = (src[1] - crop[1]) // 2
+                    x = x[:, oh:oh + crop[0], ow:ow + crop[1]]
+                if dst and dst != (crop or src[:2]):
                     x = device_resize_bilinear(x, dst[0], dst[1])
                     if was_u8:
                         # emulate the host path's uint8 re-quantization
@@ -187,14 +210,23 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
                 return x
         else:
             base = _to_float
+            fused = None
 
-        mu = self._state.get("input_mu")
         if mu is not None:
             mu_d = jnp.asarray(mu)
             sigma_d = jnp.asarray(self._state["input_sigma"])
-            pre = lambda x: (base(x) - mu_d) / sigma_d
+            norm = lambda x: (base(x) - mu_d) / sigma_d
         else:
-            pre = base
+            norm = base
+
+        if fused is not None:
+            # uint8 wire input runs the single fused Pallas kernel
+            # (crop+resize+requantize+normalize, SURVEY §7); float input —
+            # the lossless path — keeps the jnp route, numerically the
+            # same pipeline
+            pre = lambda x: fused(x) if x.dtype == jnp.uint8 else norm(x)
+        else:
+            pre = norm
 
         def bind(jitted):
             if mesh is None:
@@ -205,9 +237,25 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
                     return jitted(params, x)
             return call
 
+        def bind_stack(fn):
+            """Whole-pass program over the resident (steps, bs, ...) stack:
+            ``lax.map`` runs the per-batch body as ONE compiled scan — one
+            dispatch and one fetch for the entire pass, where a Python
+            loop pays per-batch dispatch (murder over a tunneled link; the
+            body still compiles once, and per-iteration activations free
+            across scan steps, so memory stays at one batch's worth plus
+            the output). Single-device only; mesh scoring keeps its loop
+            (batch shardings don't thread through lax.map's carry)."""
+            if mesh is not None:
+                return None
+            stack_jit = jax.jit(
+                lambda p, stack: jax.lax.map(lambda x: fn(p, x), stack))
+            return lambda stack: stack_jit(params, stack)
+
         if not node:
             jitted = jax.jit(lambda p, x: module.apply(p, pre(x)))
-            return bind(jitted), None, mesh
+            inner = lambda p, x: module.apply(p, pre(x))
+            return bind(jitted), bind_stack(inner), None, mesh
 
         from mmlspark_tpu.models.zoo.resnet import apply_with_intermediates
 
@@ -236,8 +284,7 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
             jax.ShapeDtypeStruct(probe_shape, dt))
         capture_all = not select(probe)
 
-        @jax.jit
-        def jitted(p, x):
+        def inner(p, x):
             _, inters = apply_with_intermediates(module, p, pre(x),
                                                  capture_all=capture_all)
             matches = select(inters)
@@ -245,7 +292,9 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
                 raise SchemaError(
                     f"output node {node!r} not found; have {sorted(inters)}")
             return matches[0]
-        return bind(jitted), node, mesh
+
+        jitted = jax.jit(inner)
+        return bind(jitted), bind_stack(inner), node, mesh
 
     def _coerce_batch(self, arr: np.ndarray, spec) -> np.ndarray:
         """Host-side input coercion (reference UDFs :195-212) + reshape.
@@ -274,7 +323,7 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
 
     def transform(self, frame: Frame) -> Frame:
         spec = self._spec()
-        apply, _, mesh = self._cached_jit(
+        apply, apply_stack, _, mesh = self._cached_jit(
             lambda: self._build_apply(),
             key=(self.architecture, repr(self.get("architectureArgs")),
                  self.outputNodeName, repr(self.get("devicePreprocess")),
@@ -286,7 +335,21 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         if self.get("deviceCache") != "off" and frame.count():
             dev = self._resident_input(frame, spec, bs)
             if dev is not None:
-                return self._transform_resident(frame, apply, dev, bs)
+                # the whole-pass program materializes the ENTIRE output
+                # stack in HBM before the one fetch — fine for logits or
+                # pooled features, not for a wide intermediate layer on a
+                # big frame. Over-budget outputs fall back to per-batch
+                # slices of the resident input with bounded retire windows.
+                from mmlspark_tpu.models import residency
+                out_spec = jax.eval_shape(apply_stack, dev)
+                out_bytes = int(np.prod(out_spec.shape)
+                                * out_spec.dtype.itemsize)
+                if self.get("deviceCache") == "on" \
+                        or residency._fits(dev.nbytes + out_bytes):
+                    return self._transform_resident(frame, apply_stack,
+                                                    dev, bs)
+                return self._transform_resident_windowed(frame, apply,
+                                                         dev, bs)
         # Async scoring loop: a batch's transfer + forward is DISPATCHED
         # before earlier results are fetched (JAX dispatch returns
         # immediately), so host->device DMA overlaps compute instead of the
@@ -357,6 +420,12 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         fingerprint = (self.inputCol, bs, spec.get("input_dtype"),
                        tuple(spec["input_shape"]),
                        repr(self.get("devicePreprocess")))
+        # size hint from one coerced row, so an over-budget frame is
+        # rejected before build() materializes a full-dataset host copy
+        steps = int(np.ceil(frame.count() / bs))
+        head = self._coerce_batch(
+            np.asarray([np.asarray(frame.head(1)[0][self.inputCol])]), spec)
+        hint = steps * bs * head[0].nbytes
 
         def build() -> np.ndarray:
             stacked = []
@@ -370,12 +439,26 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
 
         return residency.resident_batches(
             frame, fingerprint, build,
-            force=self.get("deviceCache") == "on")
+            force=self.get("deviceCache") == "on", nbytes_hint=hint)
 
-    def _transform_resident(self, frame: Frame, apply, dev, bs: int) -> Frame:
-        """Score from the resident stack: every batch is a device-side
-        slice of ``dev`` — zero steady-state host->HBM transfer, the same
-        retire-window discipline as the streaming loop."""
+    def _transform_resident(self, frame: Frame, apply_stack, dev,
+                            bs: int) -> Frame:
+        """Score from the resident stack as ONE compiled whole-pass
+        program (``lax.map`` over the (steps, bs, ...) stack): zero
+        steady-state host->HBM input transfer AND a single dispatch +
+        single output fetch for the entire pass. Pad rows sit at the tail
+        of the last batch, so one flat slice drops them."""
+        n_total = frame.count()
+        out = apply_stack(dev)                      # (steps, bs, ...)
+        out = np.asarray(jax.device_get(out))
+        out = out.reshape((out.shape[0] * out.shape[1],) + out.shape[2:])
+        return self._emit(frame, [out[:n_total]])
+
+    def _transform_resident_windowed(self, frame: Frame, apply, dev,
+                                     bs: int) -> Frame:
+        """Resident INPUT, bounded output: per-batch device slices of the
+        resident stack through the per-batch apply, outputs retired in
+        windows — for outputs too wide to co-reside as one stack."""
         window, in_flight = 32, 8
         n_total = frame.count()
         dev_outs: list = []

@@ -39,21 +39,28 @@ _TOTAL_UPLOADS = 0   # cumulative device puts since clear() (observability)
 
 def resident_batches(frame, fingerprint: Tuple, build: Callable[[], np.ndarray],
                      force: bool = False,
-                     budget_mb: Optional[float] = None):
+                     budget_mb: Optional[float] = None,
+                     nbytes_hint: Optional[int] = None):
     """The device-resident (steps, bs, ...) stack for ``frame``, or None.
 
     ``build()`` returns the fully coerced, tail-padded host stack; it runs
-    only on a cache miss. The budget check runs on that stack's actual
-    nbytes and gates the DEVICE transfer (a miss pays the host-side
-    materialization either way — the same coercion work the streaming
-    loop does). ``force=True`` skips the budget check (deviceCache='on').
-    Each fingerprint budgets independently; feeding one frame to models
-    with many DIFFERENT coercions multiplies residency, but the dominant
-    callers (FindBestModel candidates, repeated eval passes) share one.
+    only on a cache miss. ``nbytes_hint`` (the stack size computed from
+    shapes/dtypes) lets an over-budget frame be rejected BEFORE build()
+    materializes a full-dataset host copy — without it, every transform of
+    an over-budget frame would allocate and discard ~dataset-sized RAM on
+    the way to streaming anyway. The post-build check on actual nbytes
+    still runs (the hint is an estimate). ``force=True`` skips both
+    (deviceCache='on'). Each fingerprint budgets independently; feeding
+    one frame to models with many DIFFERENT coercions multiplies
+    residency, but the dominant callers (FindBestModel candidates,
+    repeated eval passes) share one.
     """
     entries = _CACHE.get(frame)
     if entries is not None and fingerprint in entries:
         return entries[fingerprint]
+    if not force and nbytes_hint is not None \
+            and not _fits(nbytes_hint, budget_mb):
+        return None
     host = build()
     if not force and not _fits(host.nbytes, budget_mb):
         return None
@@ -67,7 +74,7 @@ def resident_batches(frame, fingerprint: Tuple, build: Callable[[], np.ndarray],
     return dev
 
 
-def _fits(nbytes: int, budget_mb: Optional[float]) -> bool:
+def _fits(nbytes: int, budget_mb: Optional[float] = None) -> bool:
     """2x charge like DeviceEpochCache.fits unshuffled: the resident stack
     plus the transiently-live batch slices at the consumer's peak."""
     if budget_mb is None:

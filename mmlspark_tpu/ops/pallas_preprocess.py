@@ -99,6 +99,137 @@ def make_preprocess_fn(image_shape: Tuple[int, int, int],
     return preprocess
 
 
+def _sampling_matrix(src: int, dst: int, crop_off: float = 0.0,
+                     crop_size: Optional[int] = None) -> np.ndarray:
+    """(dst, src) bilinear sampling matrix, half-pixel centers with edge
+    clamp — identical convention to the host path (``image/ops.py
+    _resize_stack``). An optional crop window folds INTO the matrix: crop
+    + resize is just a shifted/scaled sampling grid, so the fused kernel
+    gets both for the price of one matmul."""
+    size = src if crop_size is None else crop_size
+    s = crop_off + (np.arange(dst) + 0.5) * size / dst - 0.5
+    i0 = np.clip(np.floor(s).astype(np.int64), 0, src - 1)
+    i1 = np.clip(i0 + 1, 0, src - 1)
+    frac = np.clip(s - i0, 0.0, 1.0).astype(np.float32)
+    m = np.zeros((dst, src), np.float32)
+    m[np.arange(dst), i0] += 1.0 - frac
+    m[np.arange(dst), i1] += frac
+    return m
+
+
+def _crop_resize_norm_kernel(u8_ref, ry_ref, rxc_ref, mean_ref, istd_ref,
+                             out_ref):
+    """One image per grid step: cast (VPU) -> H-resize matmul (MXU) ->
+    W-resize matmul (MXU) -> requantize + normalize (VPU), all out of
+    VMEM. The W-axis matrix is pre-expanded channel-blockwise
+    (kron(Rx, I_C)) so both resizes are plain 2-D matmuls — no gathers,
+    no transposes, nothing Mosaic has to emulate."""
+    # full-f32 matmul precision: default TPU dot rounds operands to bf16,
+    # which perturbs resampled pixels by up to +-2 uint8 quanta and breaks
+    # parity with the host resize
+    x = u8_ref[0].astype(jnp.int32).astype(jnp.float32)      # (Hs, Ws*C)
+    y = jax.lax.dot(ry_ref[:], x,
+                    precision=jax.lax.Precision.HIGHEST,
+                    preferred_element_type=jnp.float32)       # (Hd, Ws*C)
+    z = jax.lax.dot(y, rxc_ref[:],
+                    precision=jax.lax.Precision.HIGHEST,
+                    preferred_element_type=jnp.float32)       # (Hd, WdC_pad)
+    # re-quantize exactly like the host resize (clip+rint back to uint8
+    # range) so fused and host routes score identical images identically
+    z = jnp.clip(jnp.round(z), 0.0, 255.0)
+    out_ref[0] = ((z - mean_ref[:]) * istd_ref[:]).astype(out_ref.dtype)
+
+
+def _pad128(n: int) -> int:
+    return ((n + 127) // 128) * 128
+
+
+@functools.partial(jax.jit, static_argnames=("src_hw", "dst_hw", "channels",
+                                             "out_dtype"))
+def _fused_crop_resize_normalize(u8: jax.Array, ry: jax.Array, rxc: jax.Array,
+                                 mean2d: jax.Array, istd2d: jax.Array,
+                                 src_hw: Tuple[int, int],
+                                 dst_hw: Tuple[int, int], channels: int,
+                                 out_dtype=jnp.float32) -> jax.Array:
+    b = u8.shape[0]
+    hs, ws = src_hw
+    hd, wd = dst_hw
+    wsc = ws * channels
+    wdc_pad = rxc.shape[1]
+    vmem = pl.ANY if _interpret() else pltpu.VMEM
+    out = pl.pallas_call(
+        _crop_resize_norm_kernel,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, hs, wsc), lambda i: (i, 0, 0),
+                         memory_space=vmem),
+            pl.BlockSpec((hd, hs), lambda i: (0, 0), memory_space=vmem),
+            pl.BlockSpec((wsc, wdc_pad), lambda i: (0, 0),
+                         memory_space=vmem),
+            pl.BlockSpec((hd, wdc_pad), lambda i: (0, 0), memory_space=vmem),
+            pl.BlockSpec((hd, wdc_pad), lambda i: (0, 0), memory_space=vmem),
+        ],
+        out_specs=pl.BlockSpec((1, hd, wdc_pad), lambda i: (i, 0, 0),
+                               memory_space=vmem),
+        out_shape=jax.ShapeDtypeStruct((b, hd, wdc_pad), out_dtype),
+        interpret=_interpret(),
+    )(u8, ry, rxc, mean2d, istd2d)
+    return out[:, :, :wd * channels].reshape(b, hd, wd, channels)
+
+
+def make_fused_preprocess_fn(src_shape: Tuple[int, int, int],
+                             resize: Optional[Tuple[int, int]] = None,
+                             crop: Optional[Tuple[int, int]] = None,
+                             mean: Sequence[float] = (0.0,),
+                             std: Sequence[float] = (1.0,),
+                             out_dtype=jnp.float32):
+    """The complete SURVEY §7 preprocess as ONE Pallas kernel: uint8 in,
+    center-crop + bilinear-resize + normalize, model-ready activations
+    out — the OpenCV pipeline the reference ran per-row on CPUs
+    (``ImageTransformer.scala:33-153``), fused ahead of the first layer.
+
+    ``fn(u8 (B, Hs*Ws*C) or (B, Hs, Ws, C)) -> (B, Hd, Wd, C)``.
+    ``crop`` is a center-crop (h, w) applied BEFORE ``resize`` (either may
+    be None); per-channel ``mean``/``std`` normalize after the host-parity
+    requantize. Compose inside the model's jit; pass
+    ``out_dtype=jnp.bfloat16`` to feed the first conv in bf16."""
+    hs, ws, c = (int(v) for v in src_shape)
+    ch, cw = (int(v) for v in crop) if crop else (hs, ws)
+    if ch > hs or cw > ws:
+        raise ValueError(f"crop {crop} exceeds source {src_shape}")
+    hd, wd = (int(v) for v in resize) if resize else (ch, cw)
+    # integer floor offsets, matching ops.center_crop's slicing — a
+    # fractional offset would blend adjacent pixels instead of cropping
+    off_h, off_w = float((hs - ch) // 2), float((ws - cw) // 2)
+    ry = _sampling_matrix(hs, hd, off_h, ch)
+    rx = _sampling_matrix(ws, wd, off_w, cw)
+    wdc_pad = _pad128(wd * c)
+    # kron(Rx^T, I_C) with lane padding: column (w*c + k) resamples
+    # channel k at output position w
+    rxc = np.zeros((ws * c, wdc_pad), np.float32)
+    for k in range(c):
+        rxc[np.ix_(np.arange(ws) * c + k, np.arange(wd) * c + k)] = rx.T
+    mean_row = np.zeros((wdc_pad,), np.float32)
+    istd_row = np.zeros((wdc_pad,), np.float32)
+    mean_row[:wd * c] = np.tile(np.broadcast_to(
+        np.asarray(mean, np.float32), (c,)), wd)
+    istd_row[:wd * c] = np.tile(1.0 / np.broadcast_to(
+        np.asarray(std, np.float32), (c,)), wd)
+    ry_d = jnp.asarray(ry)
+    rxc_d = jnp.asarray(rxc)
+    mean2d = jnp.asarray(np.broadcast_to(mean_row, (hd, wdc_pad)))
+    istd2d = jnp.asarray(np.broadcast_to(istd_row, (hd, wdc_pad)))
+
+    def preprocess(u8: jax.Array) -> jax.Array:
+        if u8.dtype != jnp.uint8:
+            u8 = u8.astype(jnp.uint8)
+        u8 = u8.reshape(u8.shape[0], hs, ws * c)
+        return _fused_crop_resize_normalize(
+            u8, ry_d, rxc_d, mean2d, istd2d, (hs, ws), (hd, wd), c,
+            out_dtype)
+    return preprocess
+
+
 def device_resize_bilinear(x: jax.Array, height: int, width: int) -> jax.Array:
     """On-device bilinear resize of (B, H, W, C) float images, half-pixel
     centers with edge clamp — the SAME convention as the host path

@@ -333,6 +333,64 @@ def test_fused_normalize_matches_numpy(rng):
     np.testing.assert_allclose(out, ref, atol=1e-6)
 
 
+def test_fused_crop_resize_normalize_matches_host_pipeline(rng):
+    """The single-kernel crop+resize+normalize (SURVEY §7) against the
+    host ops pipeline run step by step: identical up to one uint8 quantum
+    of resize-rounding tie-breaks (different f32 summation order)."""
+    import jax.numpy as jnp
+    from mmlspark_tpu.image import ops
+    from mmlspark_tpu.ops.pallas_preprocess import make_fused_preprocess_fn
+
+    B, HS, WS, C = 5, 40, 48, 3
+    u8 = rng.integers(0, 256, (B, HS, WS, C), dtype=np.uint8)
+    mean, std = (125.3, 123.0, 113.9), (63.0, 62.1, 66.7)
+    host = np.stack([
+        (ops.resize(ops.center_crop(im, 32, 36), 24, 28).astype(np.float32)
+         - mean) / std
+        for im in u8])
+    pre = make_fused_preprocess_fn((HS, WS, C), resize=(24, 28),
+                                   crop=(32, 36), mean=mean, std=std)
+    got = np.asarray(pre(jnp.asarray(u8.reshape(B, -1))))
+    assert got.shape == host.shape
+    # crop edges sample beyond the window under the folded grid (the host
+    # path clamps at the crop border); interior must agree to <=1 quantum
+    inner = (slice(None), slice(1, -1), slice(1, -1))
+    np.testing.assert_allclose(got[inner], host[inner], atol=1.01 / 62.0)
+
+    # crop-only and identity variants
+    host_c = np.stack([(ops.center_crop(im, 32, 36).astype(np.float32)
+                        - mean) / std for im in u8])
+    pre_c = make_fused_preprocess_fn((HS, WS, C), crop=(32, 36),
+                                     mean=mean, std=std)
+    np.testing.assert_allclose(
+        np.asarray(pre_c(jnp.asarray(u8.reshape(B, -1)))), host_c, atol=2e-5)
+    with pytest.raises(ValueError):
+        make_fused_preprocess_fn((8, 8, 3), crop=(9, 9))
+
+
+def test_jax_model_device_preprocess_crop(rng):
+    """devicePreprocess crop: a uint8 frame scored with the on-device
+    center-crop matches host-side crop + scoring."""
+    import jax.numpy as jnp  # noqa: F401
+    from mmlspark_tpu.models.jax_model import JaxModel
+
+    B, HS, WS = 6, 12, 12
+    u8 = rng.integers(0, 256, (B, HS * WS * 3), dtype=np.uint8)
+    from mmlspark_tpu.image import ops
+    cropped = np.stack([ops.center_crop(im.reshape(HS, WS, 3), 8, 8)
+                        for im in u8]).reshape(B, -1)
+
+    dev = JaxModel(inputCol="img", outputCol="o", miniBatchSize=4,
+                   devicePreprocess={"srcShape": [HS, WS, 3],
+                                     "crop": [8, 8]})
+    dev.set_model("vit_tiny", num_classes=5, image_size=8, patch=4, seed=2)
+    host = JaxModel(inputCol="img", outputCol="o", miniBatchSize=4)
+    host.set_model("vit_tiny", num_classes=5, image_size=8, patch=4, seed=2)
+    a = dev.transform(Frame.from_dict({"img": u8})).column("o")
+    b = host.transform(Frame.from_dict({"img": cropped})).column("o")
+    np.testing.assert_allclose(a, b, atol=2e-2)
+
+
 # -- streaming readers (bounded-memory ingestion) ---------------------------
 
 def test_stream_binary_files_matches_eager(tmp_path, rng):

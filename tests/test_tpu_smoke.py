@@ -95,6 +95,29 @@ def test_pallas_fused_normalize_matches_numpy():
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
+def test_pallas_fused_crop_resize_normalize_compiles_under_mosaic():
+    """The single-kernel crop+resize+normalize (two MXU matmuls + VPU
+    requantize/normalize) must compile under Mosaic on the real chip and
+    match the host ops pipeline to one uint8 quantum."""
+    from mmlspark_tpu.image import ops
+    from mmlspark_tpu.ops.pallas_preprocess import make_fused_preprocess_fn
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    B, HS, WS, C = 8, 64, 64, 3
+    u8 = rng.integers(0, 256, (B, HS, WS, C), dtype=np.uint8)
+    mean, std = (125.3, 123.0, 113.9), (63.0, 62.1, 66.7)
+    host = np.stack([
+        (ops.resize(ops.center_crop(im, 56, 56), 32, 32).astype(np.float32)
+         - mean) / std
+        for im in u8])
+    pre = make_fused_preprocess_fn((HS, WS, C), resize=(32, 32),
+                                   crop=(56, 56), mean=mean, std=std)
+    got = np.asarray(pre(jnp.asarray(u8.reshape(B, -1))))
+    inner = (slice(None), slice(1, -1), slice(1, -1))
+    np.testing.assert_allclose(got[inner], host[inner], atol=1.01 / 62.0)
+
+
 def test_device_resize_matches_host_within_one_gray_level():
     from mmlspark_tpu.image import ops
     from mmlspark_tpu.ops.pallas_preprocess import device_resize_bilinear
