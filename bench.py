@@ -1,4 +1,5 @@
-"""Driver benchmark over the five judged configs (BASELINE.json).
+"""Driver benchmark over the judged configs (the five BASELINE.json
+configs plus the train_large MFU lane).
 
 Headline metric (the north star): CIFAR-10 ResNet-20 featurize+train
 images/sec/chip of the FRAMEWORK path (Frame -> DeviceEpochCache HBM
@@ -8,9 +9,14 @@ loop on the same model/batch (target ratio >= 0.90). Framework/baseline
 trials are interleaved (``_best_pair``) so the tunnel's bandwidth drift
 cannot skew the ratio.
 
-The other four judged configs ride along in the same JSON line under
-"configs", each with its own baseline ratio:
+The other judged configs ride along in the same JSON line under
+"configs". EVERY config carries two interleaved baselines: vs_baseline
+(the conventional hand loop a user would write first) and
+vs_resident_baseline (the same data residency the framework path uses —
+the pure framework-overhead ratio the >=0.90 target polices):
 
+- train_large:     the MFU lane — ViT-B/16 @ 224 bf16 at an MXU-saturating
+                   batch; `mfu` here is the machine-utilization headline
 - eval:            JaxModel ResNet-20 minibatch scoring (CNTKModel parity)
                    vs an inline jit apply loop
 - image_featurize: ImageFeaturizer ResNet-50 embeddings — resize + unroll +
@@ -19,15 +25,20 @@ The other four judged configs ride along in the same JSON line under
                    overhead is the thing measured)
 - text:            TextFeaturizer-style tokenize+murmur3-hash (TIMED) +
                    TextCNN train vs the same train on pre-tokenized ids
-- vit_preprocess:  ViT-B/16 with the fused Pallas uint8 preprocess (uint8
-                   crosses PCIe, normalize fuses into the forward) vs the
+- vit_preprocess:  ViT-B/16 with the fused Pallas uint8 crop+normalize
+                   kernel (raw 256x256 uint8 crosses the wire) vs the
                    conventional unfused host-side fp32 pipeline
+
+Methodology (tunneled-chip hardening): ratios are medians of
+WITHIN-round ratios with per-round order rotation; the train config
+carries a same-seed loss-parity field; timed regions end with a value
+fetch, not block_until_ready (which under-waits on deep queues here).
 
 Prints exactly one JSON line on stdout:
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": R,
    "configs": {name: {"value": ..., "unit": ..., "vs_baseline": ...}}}
 
-Run a subset with --configs train,eval (default: all five).
+Run a subset with --configs train,eval (default: all six).
 """
 from __future__ import annotations
 
@@ -80,7 +91,7 @@ def _loss_builder(module, pre):
 # time, so a generous best-of-k is nearly free and is what defends the
 # ratios against tunnel dispatch jitter (observed swinging step time 2x on
 # a seconds scale under congestion).
-TRIALS = 8
+TRIALS = 6
 
 # Peak bf16 TFLOP/s used for the MFU readout. v5e chip peak is 197; override
 # with MMLSPARK_BENCH_PEAK_TFLOPS when benching other hardware. MFU is
@@ -116,10 +127,10 @@ def _mfu(images_per_sec: float, flops_per_step: float, batch: int):
 # Per-config soft deadline on the TIMED region (setup/compile excluded):
 # trials is a maximum; after any complete round past the deadline the
 # config stops with what it has (never fewer than 2 rounds, so the
-# interleaved ratio always exists). Keeps the whole 5-config bench bounded
+# interleaved ratio always exists). Keeps the whole 6-config bench bounded
 # when the tunnel is congested while still taking the full best-of-k in a
 # clean window.
-DEADLINE_S = 50.0
+DEADLINE_S = 38.0
 
 # Whole-bench soft budget: once exceeded, remaining configs are reported as
 # skipped instead of risking an external timeout killing the process before
@@ -226,7 +237,8 @@ def make_framework_run(images: np.ndarray, labels: np.ndarray):
         for _ in range(STEPS):
             state_box[0], metrics = trainer.train_step(
                 state_box[0], next(it), rng)
-        jax.block_until_ready(metrics["loss"])
+        jax.device_get(metrics["loss"])   # not block_until_ready: it can
+        # under-wait on deep dispatch queues over the tunnel
 
     return run
 
@@ -278,7 +290,7 @@ def make_pure_jax_run(images: np.ndarray, labels: np.ndarray):
             x, y = next(it)
             params, opt_state, loss = step(params, opt_state,
                                            jnp.asarray(x), jnp.asarray(y))
-        jax.block_until_ready(loss)
+        jax.device_get(loss)
 
     return run
 
@@ -335,7 +347,7 @@ def make_resident_jax_run(images: np.ndarray, labels: np.ndarray):
         for _ in range(STEPS):
             x, y = next(it)
             params, opt_state, loss = step(params, opt_state, x, y)
-        jax.block_until_ready(loss)
+        jax.device_get(loss)
 
     return run, flops
 
@@ -435,6 +447,122 @@ def config_train() -> dict:
             "step_ms": round(t_fw / STEPS * 1e3, 3),
             "achieved_tflops": tflops, "mfu": mfu,
             "loss_parity": _train_parity(images, labels)}
+
+
+# -- config "train_large": compute-bound MFU lane (ViT-B/16 @ 224) -----------
+
+def config_train_large() -> dict:
+    """The MFU lane: ResNet-20@32x32 can never feed the MXU (its headline
+    config measures framework overhead, not machine utilization), so this
+    config trains ViT-B/16 @ 224 in bf16 at a batch that saturates the
+    systolic array — framework path (DeviceEpochCache + DistributedTrainer
+    + fused Pallas normalize) against the same resident pure-JAX twin.
+    Timed regions end with a value fetch (device_get), because the
+    tunneled runtime's block_until_ready under-waits on deep queues."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from mmlspark_tpu.ops.pallas_preprocess import make_preprocess_fn
+    from mmlspark_tpu.parallel.trainer import DeviceEpochCache, DistributedTrainer
+    from mmlspark_tpu.models.zoo import build_model
+
+    bs, steps, n = 128, 12, 512
+    shape = (224, 224, 3)
+    rng_np = np.random.default_rng(7)
+    images = rng_np.integers(0, 256, size=(n, int(np.prod(shape))),
+                             dtype=np.uint8)
+    labels = rng_np.integers(0, 1000, size=(n,)).astype(np.int32)
+
+    module = build_model("vit_b16", num_classes=1000)["module"]
+    pre = make_preprocess_fn(shape, mean=(127.5,) * 3, std=(127.5,) * 3)
+
+    def loss_fn(params, batch, rng):
+        logits = module.apply(params, pre(batch["image"])).astype(jnp.float32)
+        import optax as _optax
+        return _optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean()
+
+    trainer = DistributedTrainer(loss_fn, optax.sgd(0.01, momentum=0.9))
+    state = trainer.init(
+        lambda: module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1,) + shape, jnp.float32)))
+    rng = jax.random.PRNGKey(1)
+    cache = DeviceEpochCache({"image": images, "label": labels}, bs,
+                             mesh=trainer.mesh)
+
+    def batches():
+        while True:
+            yield from cache.batches(0)
+
+    it = batches()
+    state_box = [state]
+    for _ in range(2):
+        state_box[0], metrics = trainer.train_step(state_box[0], next(it),
+                                                   rng)
+    jax.device_get(metrics["loss"])
+
+    def run_fw():
+        for _ in range(steps):
+            state_box[0], metrics = trainer.train_step(state_box[0],
+                                                       next(it), rng)
+        jax.device_get(metrics["loss"])
+
+    # resident pure-JAX twin
+    opt = optax.sgd(0.01, momentum=0.9)
+    mean = jnp.float32(127.5)
+
+    def base_loss(params, x_u8, y):
+        x = ((x_u8.reshape((-1,) + shape).astype(jnp.float32) - mean)
+             / mean).astype(jnp.bfloat16)
+        logits = module.apply(params, x).astype(jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(base_loss)(params, x, y)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1,) + shape, jnp.float32))
+    opt_state = opt.init(params)
+    dev = [(jnp.asarray(images[o:o + bs]), jnp.asarray(labels[o:o + bs]))
+           for o in range(0, n, bs)]
+    jax.block_until_ready(dev)
+    flops = _step_flops(step, params, opt_state, *dev[0])
+    box = [params, opt_state]
+    box[0], box[1], loss = step(box[0], box[1], *dev[0])
+    jax.device_get(loss)
+
+    def run_res():
+        loss = None
+        for i in range(steps):
+            box[0], box[1], loss = step(box[0], box[1], *dev[i % len(dev)])
+        jax.device_get(loss)
+
+    # conventional baseline: a host put per step (what a first pure-JAX
+    # loop does) — at 19 MB of uint8 per batch the wire matters even here
+    def run_stream():
+        loss = None
+        for i in range(steps):
+            o = (i % len(dev)) * bs
+            box[0], box[1], loss = step(
+                box[0], box[1], jnp.asarray(images[o:o + bs]),
+                jnp.asarray(labels[o:o + bs]))
+        jax.device_get(loss)
+
+    run_stream()
+    rounds = _robin_rounds(run_fw, run_stream, run_res, trials=4,
+                           deadline_s=40.0)
+    t_fw = _best(rounds, 0)
+    fw_ips = steps * bs / t_fw
+    tflops, mfu = _mfu(fw_ips, flops, bs)
+    return {"value": round(fw_ips, 2), "unit": "images/sec/chip",
+            "vs_baseline": round(_med_ratio(rounds, 1, 0), 4),
+            "vs_resident_baseline": round(_med_ratio(rounds, 2, 0), 4),
+            "step_ms": round(t_fw / steps * 1e3, 3),
+            "achieved_tflops": tflops, "mfu": mfu}
 
 
 # -- config "eval": JaxModel minibatch scoring (CNTKModel parity) ------------
@@ -647,7 +775,7 @@ def _textcnn_trainer():
     return module, DistributedTrainer(loss_fn, optax.adam(1e-3))
 
 
-_TEXT_EPOCHS = 10
+_TEXT_EPOCHS = 6
 
 
 def config_text() -> dict:
@@ -689,7 +817,7 @@ def config_text() -> dict:
         for epoch in range(_TEXT_EPOCHS):
             for batch in cache.batches(epoch):
                 state, metrics = trainer.train_step(state, batch, rng)
-        jax.block_until_ready(metrics["loss"])
+        jax.device_get(metrics["loss"])
 
     # baseline: featurize everything, then stream a put per step per epoch
     module_b, trainer_b = _textcnn_trainer()
@@ -713,7 +841,7 @@ def config_text() -> dict:
                     trainer_b.put_batch({"ids": ids[sl],
                                          "label": labels[sl]}),
                     rng)
-        jax.block_until_ready(metrics["loss"])
+        jax.device_get(metrics["loss"])
 
     # residency-matched baseline: same tokenize+hash, then hand-staged
     # resident batches re-used across the epochs (the framework does the
@@ -739,7 +867,7 @@ def config_text() -> dict:
         for _ in range(_TEXT_EPOCHS):
             for batch in resident:
                 state_r, metrics = trainer_r.train_step(state_r, batch, rng)
-        jax.block_until_ready(metrics["loss"])
+        jax.device_get(metrics["loss"])
 
     rounds = _robin_rounds(run_fw, run_base, run_res)
     t_fw = _best(rounds, 0)
@@ -800,7 +928,7 @@ def config_vit_preprocess() -> dict:
         out = None
         for _ in range(steps):
             out = fused(jnp.asarray(u8))
-        jax.block_until_ready(out)
+        jax.device_get(out[0, :1])
 
     run_fused()
 
@@ -823,7 +951,7 @@ def config_vit_preprocess() -> dict:
                                               off:off + size]
             x = (img.astype(np.float32) - 127.5) / 127.5
             out = forward(jnp.asarray(x))
-        jax.block_until_ready(out)
+        jax.device_get(out[0, :1])
 
     # residency-matched baseline: the SAME resident uint8 input through a
     # plain-XLA crop+normalize (jnp ops the compiler fuses itself) +
@@ -844,13 +972,13 @@ def config_vit_preprocess() -> dict:
         out = None
         for _ in range(steps):
             out = fused_jit(params, dev_u8)
-        jax.block_until_ready(out)
+        jax.device_get(out[0, :1])
 
     def run_res():
         out = None
         for _ in range(steps):
             out = xla_jit(params, dev_u8)
-        jax.block_until_ready(out)
+        jax.device_get(out[0, :1])
 
     run_unfused()
     run_res()
@@ -869,10 +997,11 @@ def config_vit_preprocess() -> dict:
 
 CONFIGS = {
     "train": config_train,
+    "train_large": config_train_large,
     "eval": config_eval,
+    "vit_preprocess": config_vit_preprocess,
     "image_featurize": config_image_featurize,
     "text": config_text,
-    "vit_preprocess": config_vit_preprocess,
 }
 
 

@@ -36,11 +36,78 @@ def _parse_mesh(text: str) -> dict:
         raise SystemExit(f"--mesh: {e}")
 
 
+def _resolve_hosts(args) -> None:
+    """Fill coordinator/num_processes/process_id from the ``--hosts``
+    list or the env contract, unless given explicitly.
+
+    The pod-launch UX (docs/DEPLOY.md): every host runs the IDENTICAL
+    command line (the ``gcloud ... ssh --worker=all`` pattern) with
+    ``--hosts h0,h1,...``; each process derives its own process-id by
+    matching its identity against the list — MMLSPARK_HOST_INDEX when
+    set (CI / heterogeneous naming), otherwise hostname/FQDN match.
+    host 0 is the coordinator (``--port`` selects the port).
+
+    Env fallbacks (external launchers: k8s indexed jobs, batch systems):
+    MMLSPARK_COORDINATOR, MMLSPARK_NUM_PROCESSES, MMLSPARK_PROCESS_ID.
+    On a real TPU pod none of this is needed — jax.distributed
+    auto-discovers from the TPU metadata when everything is left unset.
+    """
+    def env_int(name: str):
+        raw = os.environ.get(name)
+        if raw is None:
+            return None
+        try:
+            val = int(raw)
+        except ValueError:
+            raise SystemExit(
+                f"{name}={raw!r} is not an integer (unexpanded template "
+                "variable?)")
+        if val < 0:
+            raise SystemExit(f"{name}={val} must be >= 0")
+        return val
+
+    if args.coordinator is None:
+        args.coordinator = os.environ.get("MMLSPARK_COORDINATOR")
+    if args.num_processes is None:
+        args.num_processes = env_int("MMLSPARK_NUM_PROCESSES")
+    if args.process_id is None:
+        args.process_id = env_int("MMLSPARK_PROCESS_ID")
+    if not args.hosts:
+        return
+    hosts = [h.strip() for h in args.hosts.split(",") if h.strip()]
+    if not hosts:
+        raise SystemExit("--hosts: empty host list")
+    if args.coordinator is None:
+        args.coordinator = f"{hosts[0]}:{args.port}"
+    if args.num_processes is None:
+        args.num_processes = len(hosts)
+    if args.process_id is None:
+        if os.environ.get("MMLSPARK_HOST_INDEX") is not None:
+            args.process_id = env_int("MMLSPARK_HOST_INDEX")
+        else:
+            import socket
+            me = {socket.gethostname(), socket.getfqdn(),
+                  socket.gethostname().split(".")[0]}
+            matches = [i for i, h in enumerate(hosts)
+                       if h in me or h.split(".")[0] in me]
+            if len(matches) != 1:
+                raise SystemExit(
+                    f"--hosts: cannot identify this host among {hosts} "
+                    f"(I am {sorted(me)}); set MMLSPARK_HOST_INDEX or "
+                    "pass --process-id")
+            args.process_id = matches[0]
+    if args.process_id >= args.num_processes:
+        raise SystemExit(
+            f"--hosts: process id {args.process_id} out of range for "
+            f"{args.num_processes} processes")
+
+
 def cmd_run(args, passthrough: List[str]) -> int:
     from mmlspark_tpu.utils import config
     script = args.script
     if not os.path.exists(script):  # before any process-state mutation
         raise SystemExit(f"script not found: {script}")
+    _resolve_hosts(args)
     if args.mesh:
         _parse_mesh(args.mesh)  # fail fast on a bad flag
         # config tier: visible to mesh_from_config() in the user script AND
@@ -159,6 +226,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                        help="host:port of process 0 (multi-host)")
     run_p.add_argument("--num-processes", type=int, default=None)
     run_p.add_argument("--process-id", type=int, default=None)
+    run_p.add_argument("--hosts", default="",
+                       help="comma list of participating hosts; run the "
+                       "SAME command on every host and each derives its "
+                       "process-id (MMLSPARK_HOST_INDEX or hostname "
+                       "match), with host 0 as coordinator — see "
+                       "docs/DEPLOY.md")
+    run_p.add_argument("--port", type=int, default=8476,
+                       help="coordinator port used with --hosts")
     run_p.add_argument("--platform", default=None,
                        choices=["cpu", "tpu", "gpu"],
                        help="force the jax platform before the process "

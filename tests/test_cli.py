@@ -85,3 +85,118 @@ def test_console_script_installed():
                          timeout=180)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "global_devices" in out.stdout
+
+
+# -- the --hosts / env multi-host contract (docs/DEPLOY.md) ------------------
+
+def _ns(**kw):
+    import argparse
+    d = dict(coordinator=None, num_processes=None, process_id=None,
+             hosts="", port=8476)
+    d.update(kw)
+    return argparse.Namespace(**d)
+
+
+def test_hosts_contract_derivation(monkeypatch):
+    """Every host runs the identical command; each derives its own
+    process-id from the list + its identity."""
+    from mmlspark_tpu.cli import _resolve_hosts
+    import socket
+
+    # MMLSPARK_HOST_INDEX wins (indexed jobs / localhost simulations)
+    monkeypatch.setenv("MMLSPARK_HOST_INDEX", "2")
+    a = _ns(hosts="tpu-a,tpu-b,tpu-c,tpu-d", port=9000)
+    _resolve_hosts(a)
+    assert (a.coordinator, a.num_processes, a.process_id) == \
+        ("tpu-a:9000", 4, 2)
+
+    # hostname match
+    monkeypatch.delenv("MMLSPARK_HOST_INDEX")
+    me = socket.gethostname().split(".")[0]
+    a = _ns(hosts=f"other-host,{me}")
+    _resolve_hosts(a)
+    assert (a.coordinator, a.num_processes, a.process_id) == \
+        ("other-host:8476", 2, 1)
+
+    # ambiguous / absent identity -> clear error
+    a = _ns(hosts="nope-1,nope-2")
+    with pytest.raises(SystemExit, match="cannot identify this host"):
+        _resolve_hosts(a)
+    a = _ns(hosts=f"{me},{me}")
+    with pytest.raises(SystemExit, match="cannot identify this host"):
+        _resolve_hosts(a)
+
+    # explicit flags always win over derivation
+    a = _ns(hosts="a,b,c", coordinator="x:1", num_processes=7, process_id=5)
+    _resolve_hosts(a)
+    assert (a.coordinator, a.num_processes, a.process_id) == ("x:1", 7, 5)
+    a = _ns(hosts="a,b", process_id=9)
+    with pytest.raises(SystemExit, match="out of range"):
+        _resolve_hosts(a)
+
+
+def test_hosts_contract_env_fallbacks(monkeypatch):
+    from mmlspark_tpu.cli import _resolve_hosts
+    monkeypatch.setenv("MMLSPARK_COORDINATOR", "h0:7000")
+    monkeypatch.setenv("MMLSPARK_NUM_PROCESSES", "16")
+    monkeypatch.setenv("MMLSPARK_PROCESS_ID", "11")
+    a = _ns()
+    _resolve_hosts(a)
+    assert (a.coordinator, a.num_processes, a.process_id) == \
+        ("h0:7000", 16, 11)
+
+
+@pytest.mark.slow
+def test_hosts_contract_two_process_launch(tmp_path):
+    """The docs/DEPLOY.md §4 command sequence, end to end: two processes
+    run the IDENTICAL launcher command with --hosts, derive their ids
+    from MMLSPARK_HOST_INDEX, form one 4-device group, and run a
+    cross-process collective."""
+    import socket
+    import textwrap
+
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        assert jax.process_count() == 2
+        mesh = Mesh(np.asarray(jax.devices()).reshape(4), ("data",))
+        x = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("data")),
+            np.full((2,), jax.process_index() + 1.0, np.float32), (4,))
+        total = jax.jit(lambda a: a.sum(),
+                        out_shardings=NamedSharding(mesh, P()))(x)
+        v = float(jax.device_get(total.addressable_data(0)))
+        assert v == 6.0, v
+        print(f"HOSTS-OK {jax.process_index()} {v}")
+    """))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for i in range(2):
+        env = dict(os.environ)
+        env["MMLSPARK_HOST_INDEX"] = str(i)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "mmlspark_tpu.cli", "run", str(script),
+             "--platform", "cpu", "--hosts", "127.0.0.1,127.0.0.1",
+             "--port", str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+        assert f"HOSTS-OK {i}" in out, out

@@ -1,0 +1,50 @@
+"""The shipped notebooks execute headlessly, like the reference's notebook
+CI (``tools/notebook/tester/TestNotebooksLocally.py`` running
+``notebooks/samples/*.ipynb``).
+
+Also gates freshness: the notebooks are GENERATED from the examples
+(``tools/make_notebooks.py``); editing an example without regenerating
+fails here before it ships a stale notebook.
+"""
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NB_DIR = os.path.join(REPO, "notebooks")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+from make_notebooks import NOTEBOOKS, build, split_example  # noqa: E402
+
+
+def test_notebooks_are_fresh(tmp_path, monkeypatch):
+    """Regenerating must reproduce the committed bytes."""
+    import make_notebooks
+    monkeypatch.setattr(make_notebooks, "OUT", str(tmp_path))
+    for example, title in NOTEBOOKS:
+        regenerated = build(example, title)
+        committed = os.path.join(
+            NB_DIR, os.path.basename(regenerated))
+        assert os.path.exists(committed), (
+            f"{committed} missing: run python tools/make_notebooks.py")
+        assert open(regenerated).read() == open(committed).read(), (
+            f"{committed} is stale: run python tools/make_notebooks.py")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("example,title", NOTEBOOKS,
+                         ids=[n[0].split("_")[0] for n in NOTEBOOKS])
+def test_notebook_executes_headless(example, title):
+    import nbformat
+    from nbclient import NotebookClient
+
+    path = os.path.join(NB_DIR, os.path.splitext(example)[0] + ".ipynb")
+    nb = nbformat.read(path, as_version=4)
+    client = NotebookClient(
+        nb, timeout=900, kernel_name="python3",
+        resources={"metadata": {"path": NB_DIR}})
+    client.execute()   # raises CellExecutionError on any failing cell
+    ran = [c for c in nb.cells if c.cell_type == "code"
+           and c.get("execution_count")]
+    assert len(ran) >= 2

@@ -1,0 +1,121 @@
+"""Generate the shipped notebooks from the runnable examples (run once;
+output is checked in and CI-executed).
+
+The reference's notebooks are its de-facto product spec and run headless
+in CI (``notebooks/samples/*.ipynb`` + ``tools/notebook/tester/
+TestNotebooksLocally.py``). Here the single source of truth stays the
+``examples/*.py`` scripts (already executed by ``tests/test_examples.py``);
+this tool derives the .ipynb form: module docstring -> a markdown cell,
+imports -> one code cell, the body of ``main()`` (dedented, trailing
+``return`` shown as a display expression) -> the working cells. The
+notebooks land in ``notebooks/`` and execute headlessly via
+``tests/test_notebooks.py`` (nbclient), and ship in the Docker image.
+
+    python tools/make_notebooks.py          # rewrites notebooks/*.ipynb
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+import nbformat as nbf
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+OUT = os.path.join(REPO, "notebooks")
+
+# (example file, notebook title)
+NOTEBOOKS = [
+    ("101_adult_census_income_training.py",
+     "101 - Adult Census Income Training"),
+    ("301_cifar10_cnn_evaluation.py",
+     "301 - CIFAR10 CNN Evaluation"),
+    ("303_transfer_learning.py",
+     "303 - Transfer Learning"),
+]
+
+# notebooks live one directory down from the repo root with the examples'
+# shared helpers (_datasets) next to the scripts
+BOOTSTRAP = """\
+import os, sys
+_repo = os.path.abspath(os.path.join(os.getcwd(), ".."))
+for p in (_repo, os.path.join(_repo, "examples")):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+# the body below is the example script's main(); let its __file__-relative
+# paths (e.g. the committed pretrained fixture) resolve the same way
+__file__ = os.path.join(_repo, "examples", {example!r})"""
+
+
+def split_example(path: str):
+    """(docstring, imports_src, body_src) for an example module whose
+    entry point is ``main()``."""
+    src = open(path).read()
+    tree = ast.parse(src)
+    lines = src.splitlines()
+    doc = ast.get_docstring(tree) or ""
+    main_fn = next(n for n in tree.body
+                   if isinstance(n, ast.FunctionDef) and n.name == "main")
+    import_lines = []
+    for n in tree.body:
+        if isinstance(n, (ast.Import, ast.ImportFrom)):
+            if getattr(n, "module", "") == "__future__":
+                continue
+            import_lines.extend(lines[n.lineno - 1:n.end_lineno])
+    # main()'s defaulted parameters become plain assignments at the top
+    # of the body cell (e.g. ``model_dir = None``)
+    params = []
+    args = main_fn.args
+    for a, d in zip(args.args[len(args.args) - len(args.defaults):],
+                    args.defaults):
+        params.append(f"{a.arg} = {ast.unparse(d)}")
+    body_start = main_fn.body[0].lineno - 1
+    if isinstance(main_fn.body[0], ast.Expr) and isinstance(
+            main_fn.body[0].value, ast.Constant):  # main's own docstring
+        body_start = main_fn.body[1].lineno - 1
+    body = lines[body_start:main_fn.end_lineno]
+    # dedent one level
+    body = [ln[4:] if ln.startswith("    ") else ln for ln in body]
+    # a trailing `return X` becomes a display expression
+    while body and not body[-1].strip():
+        body.pop()
+    if body and body[-1].strip().startswith("return"):
+        expr = body[-1].strip()[len("return"):].strip()
+        body[-1] = expr if expr else ""
+    if params:
+        body = params + [""] + body
+    return doc, "\n".join(import_lines), "\n".join(body)
+
+
+def build(example: str, title: str) -> str:
+    doc, imports, body = split_example(os.path.join(EXAMPLES, example))
+    nb = nbf.v4.new_notebook()
+    nb.metadata["kernelspec"] = {"name": "python3", "language": "python",
+                                 "display_name": "Python 3"}
+    md = f"# {title}\n\n" + doc
+    bootstrap = BOOTSTRAP.replace("{example!r}", repr(example))
+    nb.cells = [
+        nbf.v4.new_markdown_cell(md),
+        nbf.v4.new_code_cell(bootstrap + "\n" + imports),
+        nbf.v4.new_code_cell(body),
+    ]
+    # deterministic cell ids: regeneration must be byte-stable so the
+    # freshness gate (tests/test_notebooks.py) can compare files
+    stem = os.path.splitext(example)[0]
+    for i, c in enumerate(nb.cells):
+        c["id"] = f"{stem}-{i}"
+    out = os.path.join(OUT, os.path.splitext(example)[0] + ".ipynb")
+    os.makedirs(OUT, exist_ok=True)
+    with open(out, "w") as f:
+        nbf.write(nb, f)
+    return out
+
+
+def main() -> None:
+    for example, title in NOTEBOOKS:
+        print("wrote", build(example, title))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
