@@ -72,8 +72,16 @@ def _resolve_hosts(args) -> None:
         args.num_processes = env_int("MMLSPARK_NUM_PROCESSES")
     if args.process_id is None:
         args.process_id = env_int("MMLSPARK_PROCESS_ID")
+    def check_range():
+        if args.process_id is not None and args.num_processes is not None \
+                and args.process_id >= args.num_processes:
+            raise SystemExit(
+                f"process id {args.process_id} out of range for "
+                f"{args.num_processes} processes")
+
     if not args.hosts:
-        return
+        check_range()   # the pure-env contract must fail fast too, not
+        return          # hang a jax.distributed rendezvous on a bad id
     hosts = [h.strip() for h in args.hosts.split(",") if h.strip()]
     if not hosts:
         raise SystemExit("--hosts: empty host list")
@@ -96,10 +104,7 @@ def _resolve_hosts(args) -> None:
                     f"(I am {sorted(me)}); set MMLSPARK_HOST_INDEX or "
                     "pass --process-id")
             args.process_id = matches[0]
-    if args.process_id >= args.num_processes:
-        raise SystemExit(
-            f"--hosts: process id {args.process_id} out of range for "
-            f"{args.num_processes} processes")
+    check_range()
 
 
 def cmd_run(args, passthrough: List[str]) -> int:

@@ -16,6 +16,7 @@ metrics are *returned as a Frame*.
 """
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -95,6 +96,97 @@ def confusion_matrix(y: np.ndarray, pred: np.ndarray, k: int) -> np.ndarray:
     cm = np.zeros((k, k), dtype=np.int64)
     np.add.at(cm, (y.astype(int), pred.astype(int)), 1)
     return cm
+
+
+# -- device-path evaluators --------------------------------------------------
+# Above ``evaluate.device_rows`` rows, metrics run as jitted XLA programs
+# instead of driver numpy: the scored column stays columnar and the driver
+# only ever sees the k x k confusion and two scalars — the
+# everything-streams-to-device story applied to evaluation, where the
+# reference funneled the whole scored RDD through driver-side Spark
+# aggregations (``ComputeModelStatistics.scala:86-559``). Below the
+# threshold the numpy path wins on latency (no transfer, no compile).
+
+@functools.lru_cache(maxsize=1)
+def _device_confusion_jit():
+    """Module-cached jit (a per-call jax.jit would recompile every
+    transform — FindBestModel evaluates N candidates on one frame)."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnums=2)
+    def cm(yy, pp, kk):
+        # int32 scatter-add into k*k cells: O(n) memory and exact counts
+        # (a one-hot matmul would be O(n*k) HBM and float32-inexact past
+        # 2^24 per cell)
+        flat = yy.astype(jnp.int32) * kk + pp.astype(jnp.int32)
+        return jnp.zeros((kk * kk,), jnp.int32).at[flat].add(1) \
+            .reshape(kk, kk)
+    return cm
+
+
+def _device_confusion(y, pred, k: int) -> np.ndarray:
+    import jax
+    import jax.numpy as jnp
+    out = _device_confusion_jit()(jnp.asarray(y, np.int32),
+                                  jnp.asarray(pred, np.int32), int(k))
+    return np.asarray(jax.device_get(out)).astype(np.int64)
+
+
+@functools.lru_cache(maxsize=1)
+def _device_auc_jit():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def both(yy, ss):
+        n = yy.shape[0]
+        order = jnp.argsort(-ss, stable=True)
+        ys = yy[order].astype(jnp.int32)
+        sss = ss[order]
+        # integer cumulative counts: exact up to 2^31 rows (float32
+        # cumsums stop counting past 2^24 — exactly the large-n regime
+        # this path is gated to)
+        tps = jnp.cumsum(ys)
+        fps = jnp.cumsum(1 - ys)
+        P = jnp.maximum(tps[-1], 1).astype(jnp.float32)
+        N = jnp.maximum(fps[-1], 1).astype(jnp.float32)
+        mask = jnp.concatenate([sss[:-1] != sss[1:],
+                                jnp.ones((1,), bool)])
+        idx = jnp.arange(n)
+        prev = jnp.concatenate([
+            jnp.full((1,), -1),
+            jax.lax.cummax(jnp.where(mask, idx, -1))[:-1]])
+        has_prev = prev >= 0
+        prev_c = jnp.maximum(prev, 0)
+
+        def area(xcoord, ycoord, y_anchor):
+            px = jnp.where(has_prev, xcoord[prev_c], 0.0)
+            py = jnp.where(has_prev, ycoord[prev_c], y_anchor)
+            return jnp.where(mask,
+                             (xcoord - px) * (ycoord + py) * 0.5,
+                             0.0).sum()
+
+        tpsf, fpsf = tps.astype(jnp.float32), fps.astype(jnp.float32)
+        fpr, tpr = fpsf / N, tpsf / P
+        recall = tpsf / P
+        prec = tpsf / jnp.maximum(tpsf + fpsf, 1.0)
+        return area(fpr, tpr, 0.0), area(recall, prec, 1.0)
+    return both
+
+
+def _device_auc_aucpr(y, scores) -> Tuple[float, float]:
+    """ROC-AUC and areaUnderPR as ONE fixed-shape jitted program,
+    numerically identical to the numpy staircase+trapezoid path: sort by
+    descending score, mark distinct-threshold group ends, and accumulate
+    each kept point's trapezoid against the PREVIOUS kept point found
+    with an exclusive cummax over masked indices — no dynamic shapes, no
+    host round trip per threshold."""
+    import jax
+    import jax.numpy as jnp
+    a, pr = _device_auc_jit()(jnp.asarray(np.asarray(y, np.int32)),
+                              jnp.asarray(np.asarray(scores, np.float32)))
+    return float(jax.device_get(a)), float(jax.device_get(pr))
 
 
 def binary_accuracy_precision_recall(cm: np.ndarray) -> Tuple[float, float, float]:
@@ -208,7 +300,10 @@ class ComputeModelStatistics(Transformer):
         pred = np.asarray(frame.column(scored_labels),
                           dtype=np.float64).astype(np.int64)
         k = int(max(y.max(initial=0), pred.max(initial=0))) + 1
-        cm = confusion_matrix(y, pred, k)
+        from mmlspark_tpu.utils import config as mmlconfig
+        on_device = len(y) >= int(mmlconfig.get("evaluate.device_rows"))
+        cm = (_device_confusion(y, pred, k) if on_device
+              else confusion_matrix(y, pred, k))
         self.confusion_matrix = cm
 
         metrics: Dict[str, float] = {}
@@ -218,11 +313,18 @@ class ComputeModelStatistics(Transformer):
             if scores is not None:
                 sc = np.asarray(frame.column(scores))
                 pos = sc[:, 1] if sc.ndim == 2 and sc.shape[1] >= 2 else sc.ravel()
-                curve = roc_curve(y, pos.astype(np.float64))
-                self.roc_curve = curve
-                metrics[AUC] = auc_from_roc(curve)
-                metrics[AUC_PR] = auc_from_pr(
-                    pr_curve(y, pos.astype(np.float64)))
+                if on_device:
+                    # the full ROC staircase (n points) is not fetched to
+                    # the driver above the threshold; metric scalars come
+                    # from the jitted program
+                    metrics[AUC], metrics[AUC_PR] = _device_auc_aucpr(
+                        y, pos)
+                else:
+                    curve = roc_curve(y, pos.astype(np.float64))
+                    self.roc_curve = curve
+                    metrics[AUC] = auc_from_roc(curve)
+                    metrics[AUC_PR] = auc_from_pr(
+                        pr_curve(y, pos.astype(np.float64)))
         else:
             mc = multiclass_metrics(cm)
             metrics.update(mc)

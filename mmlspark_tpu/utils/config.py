@@ -23,6 +23,12 @@ _DEFAULTS: Dict[str, Any] = {
     "runtime.decode_threads": 0,      # 0 = native codec picks (ncpu)
     "runtime.mesh": "",               # launcher default, e.g. "data=-1,tensor=2"
     "runtime.device_cache_mb": 1024,  # HBM budget for device-resident epochs
+    # evaluation: rows above which evaluators run as jitted XLA programs
+    # instead of driver numpy. The device path wins when chips are
+    # locally attached (the scored column crosses PCIe once instead of
+    # funneling through single-threaded numpy sorts); on remote/tunneled
+    # devices the transfer dominates — raise (or set huge) there.
+    "evaluate.device_rows": 1_000_000,
     # logging
     "logging.level": "INFO",
     "logging.metrics_every": 0,       # default train-metric log cadence (steps)

@@ -122,3 +122,56 @@ def test_find_best_model_shares_one_featurize_pass(monkeypatch):
     table = dict(zip(cols["model_uid"], cols["AUC"]))
     for c, exp in zip(cands, expected):
         np.testing.assert_allclose(float(table[c.uid]), exp, rtol=1e-6)
+
+
+def test_device_path_evaluators_match_numpy(monkeypatch):
+    """Above the evaluate.device_rows threshold the metrics come from
+    jitted XLA programs (one-hot-matmul confusion, masked-staircase
+    AUC/areaUnderPR); both paths must agree to float tolerance, including
+    under heavy score TIES (the staircase's distinct-threshold grouping)."""
+    from mmlspark_tpu.evaluate.compute_model_statistics import (
+        ComputeModelStatistics,
+    )
+    from mmlspark_tpu.core.schema import (
+        ColumnSchema, DType, ScoreKind, set_score_column,
+    )
+    from mmlspark_tpu.utils import config
+
+    rng = np.random.default_rng(7)
+    n = 5000
+    y = rng.integers(0, 2, n).astype(np.float64)
+    # quantized scores -> massive tie groups
+    s1 = np.round(np.clip(rng.normal(0.3 + 0.4 * y, 0.3, n), 0, 1), 2)
+    scores = np.stack([1 - s1, s1], axis=1).astype(np.float32)
+    pred = (s1 > 0.5).astype(np.float64)
+
+    frame = Frame.from_dict({"label": y, "scored_labels": pred})
+    frame = frame.with_column_values(
+        ColumnSchema("scores", DType.VECTOR), scores)
+    schema = set_score_column(frame.schema, "scores", "m1",
+                              ScoreKind.SCORES, ScoreKind.CLASSIFICATION)
+    schema = set_score_column(schema, "scored_labels", "m1",
+                              ScoreKind.SCORED_LABELS,
+                              ScoreKind.CLASSIFICATION)
+    frame = Frame(schema, frame.partitions)
+
+    def run():
+        ev = ComputeModelStatistics()
+        row = ev.transform(frame).head(1)[0]
+        return {k: float(v) for k, v in row.items()}, ev.confusion_matrix
+
+    config.set("evaluate.device_rows", 10**9)
+    try:
+        host, cm_host = run()
+    finally:
+        config.unset("evaluate.device_rows")
+    config.set("evaluate.device_rows", 1)
+    try:
+        dev, cm_dev = run()
+    finally:
+        config.unset("evaluate.device_rows")
+    assert host.keys() == dev.keys()
+    for k in host:
+        np.testing.assert_allclose(dev[k], host[k], rtol=1e-5, atol=1e-6,
+                                   err_msg=k)
+    np.testing.assert_array_equal(cm_dev, cm_host)
