@@ -316,7 +316,14 @@ class ComputeModelStatistics(Transformer):
                 if on_device:
                     # the full ROC staircase (n points) is not fetched to
                     # the driver above the threshold; metric scalars come
-                    # from the jitted program
+                    # from the jitted program — say so, because callers
+                    # that expect the roc_curve artifact get None here
+                    from mmlspark_tpu.utils.logging import get_logger
+                    get_logger("evaluate").info(
+                        "device-path evaluation (%d rows >= "
+                        "evaluate.device_rows): roc_curve artifact not "
+                        "materialized; lower the threshold to retain it",
+                        len(y))
                     metrics[AUC], metrics[AUC_PR] = _device_auc_aucpr(
                         y, pos)
                 else:

@@ -38,12 +38,23 @@ from mmlspark_tpu.parallel.sharding import active_batch_axes
 
 
 def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   causal: bool = True) -> jnp.ndarray:
-    """Plain softmax attention (B, L, H, D) — the single-device reference.
+                   causal: bool = True,
+                   use_flash: str = "auto") -> jnp.ndarray:
+    """Single-device attention (B, L, H, D).
 
-    Matmuls run in the input dtype (bf16 tiles the MXU); scores, softmax and
-    the output accumulation are fp32, cast back once at the end.
+    On an accelerator backend with block-divisible shapes this runs the
+    fused Pallas flash kernel (``ops/pallas_attention.py``) — the L x L
+    score matrix never touches HBM. Everything else (CPU lanes, ragged
+    lengths like ViT's 197 tokens) takes the jnp reference below: matmuls
+    in the input dtype (bf16 tiles the MXU); scores, softmax and the
+    output accumulation in fp32, cast back once at the end.
+    ``use_flash``: "auto" | "never" (reference path, used by the parity
+    tests themselves).
     """
+    if use_flash == "auto" and jax.default_backend() != "cpu":
+        from mmlspark_tpu.ops import pallas_attention
+        if pallas_attention.supports(q.shape):
+            return pallas_attention.flash_attention(q, k, v, causal=causal)
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum("blhd,bkhd->bhlk", q, k,
                    preferred_element_type=jnp.float32) * scale
