@@ -332,6 +332,13 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         bs = self.miniBatchSize
         if mesh is not None:
             return self._transform_sharded(frame, spec, apply, mesh, bs)
+        if self.get("deviceCache") == "on" \
+                and getattr(frame, "_out_of_core", False):
+            raise ValueError(
+                "deviceCache='on' would materialize an out-of-core "
+                "DiskFrame; score it with deviceCache='auto'/'off' "
+                "(streams), or materialize it to an in-memory Frame "
+                "first if it fits")
         if self.get("deviceCache") != "off" and frame.count():
             dev = self._resident_input(frame, spec, bs)
             if dev is not None:

@@ -55,6 +55,12 @@ def resident_batches(frame, fingerprint: Tuple, build: Callable[[], np.ndarray],
     residency, but the dominant callers (FindBestModel candidates,
     repeated eval passes) share one.
     """
+    if getattr(frame, "_out_of_core", False):
+        # DiskFrame and friends must never materialize through build() —
+        # streaming them is their whole point. Guarded HERE so every
+        # caller inherits it; callers that want to surface the conflict
+        # loudly (an explicit force request) check before calling.
+        return None
     entries = _CACHE.get(frame)
     if entries is not None and fingerprint in entries:
         return entries[fingerprint]

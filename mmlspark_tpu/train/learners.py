@@ -680,9 +680,15 @@ def _device_feature_batches(model, frame: Frame, bs: int):
             _pad_rows(np.asarray(b[model.featuresCol], np.float32), bs)
             for b in frame.batches(bs, cols=[model.featuresCol])])
 
-    dev = residency.resident_batches(
-        frame, (model.featuresCol, bs, "learner-f32"), build) \
-        if n_rows else None
+    dev = None
+    # residency declines out-of-core frames itself; the hint rejects
+    # over-budget frames BEFORE any materialization
+    if n_rows:
+        d = np.asarray(frame.head(1)[0][model.featuresCol]).size
+        steps = int(np.ceil(n_rows / bs))
+        dev = residency.resident_batches(
+            frame, (model.featuresCol, bs, "learner-f32"), build,
+            nbytes_hint=steps * bs * d * 4)
     if dev is not None:
         for i in range(dev.shape[0]):
             yield dev[i], min(bs, n_rows - i * bs)

@@ -223,9 +223,19 @@ def test_bigger_than_budget_fit_bounded_rss(tmp_path):
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)  # single device: no 8x runtime overhead
 
+    # Two-stage spawn: ru_maxrss is a fork-inherited high-water mark, so a
+    # worker forked from a FAT parent (pytest after a long session) starts
+    # with the parent's peak RSS already on its books and both modes read
+    # identically. Forking the real worker from a tiny trampoline python
+    # gives it an honest baseline.
+    trampoline = ("import subprocess, sys; "
+                  "sys.exit(subprocess.run([sys.executable] + "
+                  "sys.argv[1:]).returncode)")
+
     def rss_mb(mode):
         out = subprocess.run(
-            [sys.executable, "-c", _RSS_WORKER, path, mode],
+            [sys.executable, "-c", trampoline,
+             "-c", _RSS_WORKER, path, mode],
             env=env, capture_output=True, text=True, timeout=600)
         assert out.returncode == 0, out.stderr[-3000:]
         line = [l for l in out.stdout.splitlines() if l.startswith("RSS")][0]
