@@ -25,12 +25,23 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = os.path.join(REPO, "examples")
 OUT = os.path.join(REPO, "notebooks")
 
-# (example file, notebook title)
+# (example file, notebook title) — every single-process example ships as
+# a notebook (304 self-launches OS processes; it stays script-only)
 NOTEBOOKS = [
     ("101_adult_census_income_training.py",
      "101 - Adult Census Income Training"),
+    ("102_flight_delay_regression.py",
+     "102 - Flight Delay Regression"),
+    ("103_before_and_after.py",
+     "103 - Before and After (save/load)"),
+    ("201_text_featurizer.py",
+     "201 - Text Featurization"),
+    ("202_word2vec.py",
+     "202 - Word2Vec Embeddings"),
     ("301_cifar10_cnn_evaluation.py",
      "301 - CIFAR10 CNN Evaluation"),
+    ("302_pipeline_image_transformations.py",
+     "302 - Pipeline Image Transformations"),
     ("303_transfer_learning.py",
      "303 - Transfer Learning"),
 ]
@@ -49,8 +60,9 @@ __file__ = os.path.join(_repo, "examples", {example!r})"""
 
 
 def split_example(path: str):
-    """(docstring, imports_src, body_src) for an example module whose
-    entry point is ``main()``."""
+    """(docstring, imports_src, support_src, body_src) for an example
+    module whose entry point is ``main()``. ``support`` is every other
+    top-level statement (helper functions, constants) the body needs."""
     src = open(path).read()
     tree = ast.parse(src)
     lines = src.splitlines()
@@ -58,11 +70,25 @@ def split_example(path: str):
     main_fn = next(n for n in tree.body
                    if isinstance(n, ast.FunctionDef) and n.name == "main")
     import_lines = []
-    for n in tree.body:
+    support_lines = []
+    for pos, n in enumerate(tree.body):
         if isinstance(n, (ast.Import, ast.ImportFrom)):
             if getattr(n, "module", "") == "__future__":
                 continue
             import_lines.extend(lines[n.lineno - 1:n.end_lineno])
+            continue
+        if n is main_fn:
+            continue
+        if pos == 0 and isinstance(n, ast.Expr) \
+                and isinstance(n.value, ast.Constant):
+            continue                       # module docstring
+        if isinstance(n, ast.If) and getattr(
+                getattr(n.test, "left", None), "id", "") == "__name__":
+            continue                       # the __main__ guard
+        start = n.lineno
+        if getattr(n, "decorator_list", None):
+            start = n.decorator_list[0].lineno   # include decorators
+        support_lines.extend(lines[start - 1:n.end_lineno] + [""])
     # main()'s defaulted parameters become plain assignments at the top
     # of the body cell (e.g. ``model_dir = None``)
     params = []
@@ -85,11 +111,13 @@ def split_example(path: str):
         body[-1] = expr if expr else ""
     if params:
         body = params + [""] + body
-    return doc, "\n".join(import_lines), "\n".join(body)
+    return (doc, "\n".join(import_lines),
+            "\n".join(support_lines).strip(), "\n".join(body))
 
 
 def build(example: str, title: str) -> str:
-    doc, imports, body = split_example(os.path.join(EXAMPLES, example))
+    doc, imports, support, body = split_example(
+        os.path.join(EXAMPLES, example))
     nb = nbf.v4.new_notebook()
     nb.metadata["kernelspec"] = {"name": "python3", "language": "python",
                                  "display_name": "Python 3"}
@@ -98,8 +126,10 @@ def build(example: str, title: str) -> str:
     nb.cells = [
         nbf.v4.new_markdown_cell(md),
         nbf.v4.new_code_cell(bootstrap + "\n" + imports),
-        nbf.v4.new_code_cell(body),
     ]
+    if support:
+        nb.cells.append(nbf.v4.new_code_cell(support))
+    nb.cells.append(nbf.v4.new_code_cell(body))
     # deterministic cell ids: regeneration must be byte-stable so the
     # freshness gate (tests/test_notebooks.py) can compare files
     stem = os.path.splitext(example)[0]
