@@ -30,7 +30,7 @@ the pure framework-overhead ratio the >=0.90 target polices):
                    conventional unfused host-side fp32 pipeline
 
 Methodology (tunneled-chip hardening): ratios are medians of
-WITHIN-round ratios with per-round order rotation; the train config
+WITHIN-round ratios with the run order permuted per round; the train config
 carries a same-seed loss-parity field; timed regions end with a value
 fetch, not block_until_ready (which under-waits on deep queues here).
 
@@ -132,11 +132,37 @@ def _mfu(images_per_sec: float, flops_per_step: float, batch: int):
 # clean window.
 DEADLINE_S = 38.0
 
+# set by main() before each config: shrinks timed regions when the whole-
+# bench budget is running out (congested tunnel), instead of skipping
+# whole configs. None outside main().
+_DYN_DEADLINE_S = None
+
 # Whole-bench soft budget: once exceeded, remaining configs are reported as
 # skipped instead of risking an external timeout killing the process before
 # the one-line JSON contract is honored (the headline train config runs
 # first). Override with MMLSPARK_BENCH_BUDGET_S.
 BUDGET_S = 480.0
+
+
+_WARM_BUF = None
+
+
+def _link_warm():
+    """Equalize the tunnel's per-connection state before a timed region:
+    one moderate put + a tiny fetch. Heavy activity leaves the link 'hot'
+    (~40 ms faster next sync) for ~100 ms; without this, whichever region
+    follows the heavy streaming baseline inherits the advantage and no
+    amount of order scheduling fully cancels it at small trial counts
+    (measured: the worst-case fixed order reads ratio ~1.0 with the warm,
+    0.65-0.8 without). No-op on CPU backends."""
+    import jax
+    if jax.default_backend() == "cpu":
+        return
+    global _WARM_BUF
+    if _WARM_BUF is None:
+        _WARM_BUF = np.zeros(4_000_000, np.uint8)
+    d = jax.device_put(_WARM_BUF)
+    jax.device_get(d[:8])
 
 
 def _robin_rounds(*runs, trials: int = TRIALS,
@@ -149,19 +175,30 @@ def _robin_rounds(*runs, trials: int = TRIALS,
     ratios be computed WITHIN rounds and medianed across them — a ratio
     of two bests taken in different bandwidth windows is exactly the
     artifact this exists to kill."""
+    if _DYN_DEADLINE_S is not None:
+        deadline_s = min(deadline_s, _DYN_DEADLINE_S)
     rounds = []
     start = time.perf_counter()
-    # shuffle the order each round: the tunnel keeps per-connection state
-    # (window/latency) for ~100 ms after heavy activity, so whoever runs
-    # right after the heavy streaming baseline measures ~40 ms faster.
-    # A fixed order turns that into systematic bias — and so does cyclic
-    # ROTATION, which preserves who-follows-whom exactly; only a fresh
-    # permutation per round breaks the adjacency structure. Seeded, so a
-    # bench run is reproducible.
-    rng = np.random.default_rng(20260731)
+    # The PRIMARY defense against tunnel link-state bias is _link_warm
+    # before sub-second regions; varying the order per round (rotations,
+    # then reversed rotations) is a secondary hedge that balances
+    # neighbor adjacency over 2n rounds. Neither is perfect for regions
+    # just above the warm threshold — accepted residual, noted here so
+    # nobody mistakes the schedule for a full Latin square.
+    n = len(runs)
     for r in range(trials):
-        ts = [0.0] * len(runs)
-        for i in rng.permutation(len(runs)):
+        order = [(j + r) % n for j in range(n)]
+        if n > 1 and (r // n) % 2 == 1:
+            order.reverse()
+        ts = [0.0] * n
+        for i in order:
+            # warm only ahead of sync-floor-dominated (sub-second)
+            # regions: each warm costs a round trip, and the bench must
+            # fit the driver budget. The 1.0 s cliff leaves a ~40 ms
+            # (<4%) residual on regions just above it — accepted;
+            # raising the threshold re-broke the whole-bench budget
+            if not rounds or rounds[-1][i] < 1.0:
+                _link_warm()
             t0 = time.perf_counter()
             runs[i]()
             ts[i] = time.perf_counter() - t0
@@ -468,7 +505,7 @@ def config_train_large() -> dict:
     from mmlspark_tpu.parallel.trainer import DeviceEpochCache, DistributedTrainer
     from mmlspark_tpu.models.zoo import build_model
 
-    bs, steps, n = 128, 12, 512
+    bs, steps, n = 128, 12, 256
     shape = (224, 224, 3)
     rng_np = np.random.default_rng(7)
     images = rng_np.integers(0, 256, size=(n, int(np.prod(shape))),
@@ -629,11 +666,11 @@ def config_eval() -> dict:
 
     run_base()
     run_res()
-    # eval rounds are ~0.5 s each: extra trials are nearly free and the
-    # median ratio on this transfer-latency-bound config needs them (the
-    # per-pass sync floor swings +-40 ms with tunnel connection state)
+    # 8 trials (vs the default 6): eval rounds are cheap and this config
+    # is the most sync-floor-bound; the link warm removes the systematic
+    # bias, extra rounds shrink the residual symmetric noise
     rounds = _robin_rounds(lambda: jm.transform(frame), run_base, run_res,
-                           trials=12)
+                           trials=8)
     t_fw = _best(rounds, 0)
     fw_ips = n / t_fw
     flops = _step_flops(jitted, params,
@@ -937,7 +974,7 @@ def config_vit_preprocess() -> dict:
             out = fused(jnp.asarray(u8))
         jax.device_get(out[0, :1])
 
-    run_fused()
+    jax.device_get(fused(jnp.asarray(u8))[0, :1])   # compile + one pass
 
     # baseline: conventional unfused pipeline — crop + normalize on host
     # in fp32 (the OpenCV-style CPU preprocess), ship 4x the bytes, then
@@ -951,13 +988,15 @@ def config_vit_preprocess() -> dict:
     def forward(x):
         return forward_jit(params, x)
 
+    def host_crop_norm():
+        img = u8.reshape(bs, src, src, 3)[:, off:off + size,
+                                          off:off + size]
+        return (img.astype(np.float32) - 127.5) / 127.5
+
     def run_unfused():
         out = None
         for _ in range(steps):
-            img = u8.reshape(bs, src, src, 3)[:, off:off + size,
-                                              off:off + size]
-            x = (img.astype(np.float32) - 127.5) / 127.5
-            out = forward(jnp.asarray(x))
+            out = forward(jnp.asarray(host_crop_norm()))
         jax.device_get(out[0, :1])
 
     # residency-matched baseline: the SAME resident uint8 input through a
@@ -987,9 +1026,8 @@ def config_vit_preprocess() -> dict:
             out = xla_jit(params, dev_u8)
         jax.device_get(out[0, :1])
 
-    run_unfused()
-    run_res()
-    run_fused_res()
+    jax.device_get(forward(jnp.asarray(host_crop_norm()))[0, :1])
+    jax.device_get(xla_jit(params, dev_u8)[0, :1])       # compile resident
     rounds = _robin_rounds(run_fused, run_unfused, run_fused_res, run_res)
     t_fw = _best(rounds, 0)
     fw_ips = steps * bs / t_fw
@@ -1027,7 +1065,7 @@ def _enable_compile_cache() -> None:
         pass  # older jaxlib without the persistent cache: just slower
 
 
-def main() -> None:
+def main() -> int:
     _enable_compile_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default=",".join(CONFIGS),
@@ -1043,19 +1081,71 @@ def main() -> None:
         raise SystemExit("no configs selected")
 
     import os
+    import signal
     budget = float(os.environ.get("MMLSPARK_BENCH_BUDGET_S", BUDGET_S))
     start = time.perf_counter()
     results = {}
-    for name in names:
-        if results and time.perf_counter() - start > budget:
-            results[name] = {"skipped": True,
-                             "reason": "bench time budget exhausted"}
-            print(f"# {name}: skipped (budget)", file=sys.stderr)
-            continue
-        results[name] = CONFIGS[name]()
-        print(f"# {name}: {results[name]}", file=sys.stderr)
+
+    # An external timeout (the driver's) may SIGTERM the process under
+    # severe tunnel congestion before every config finishes. The one-
+    # JSON-line contract survives: emit whatever completed, mark the
+    # rest, and exit.
+    class _Terminated(Exception):
+        pass
+
+    def _on_term(signum, frame):
+        raise _Terminated()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):
+        pass  # non-main thread / platform without signals
+
+    global _DYN_DEADLINE_S
+    terminated = False
+    try:
+        for pos, name in enumerate(names):
+            if results and time.perf_counter() - start > budget:
+                results[name] = {"skipped": True,
+                                 "reason": "bench time budget exhausted"}
+                print(f"# {name}: skipped (budget)", file=sys.stderr)
+                continue
+            # adaptive deadline: under tunnel congestion every config
+            # runs long; shrinking the remaining configs' timed regions
+            # (down to the 2-round minimum that still yields interleaved
+            # ratios) beats skipping them outright
+            remaining = max(budget - (time.perf_counter() - start), 1.0)
+            _DYN_DEADLINE_S = max(8.0, 0.6 * remaining / (len(names) - pos))
+            results[name] = CONFIGS[name]()
+            print(f"# {name}: {results[name]}", file=sys.stderr)
+    except (_Terminated, KeyboardInterrupt):
+        # drivers often re-send TERM before escalating to KILL; a second
+        # delivery must not blow away the epilogue that prints the line.
+        # (Best effort only: a SIGTERM that lands while blocked inside a
+        # C call is deferred until the call returns — if the driver's
+        # KILL arrives first, nothing can be printed.)
+        try:
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        except (ValueError, OSError):
+            pass
+        terminated = True
+        for name in names:
+            results.setdefault(name, {
+                "skipped": True, "reason": "terminated (external timeout)"})
+        print("# terminated early; emitting partial results",
+              file=sys.stderr)
+    _DYN_DEADLINE_S = None
 
     ran = [n for n in names if not results[n].get("skipped")]
+    if not ran:
+        stub = ("cifar10_resnet20_train_images_per_sec_per_chip"
+                if "train" in names else f"bench_{names[0]}")
+        print(json.dumps({
+            "metric": stub,
+            "value": 0, "unit": "images/sec/chip", "vs_baseline": 0,
+            "configs": results,
+            "error": "terminated before any config completed"}))
+        return 3  # machine-visible: killed, the value-0 line is a stub
     # headline = the north-star train config when it ran; otherwise name
     # the metric after the config it actually carries
     head_name = "train" if "train" in ran else ran[0]
@@ -1073,6 +1163,8 @@ def main() -> None:
         if head.get(k) is not None:
             line[k] = head[k]
     print(json.dumps(line))
+    if terminated:
+        return 3  # partial results: the line is honest but incomplete
 
 
 if __name__ == "__main__":
