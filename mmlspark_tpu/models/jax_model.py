@@ -551,10 +551,22 @@ def _to_float(x):
 
 
 def _to_plain(tree):
-    """FrozenDict / jax arrays -> plain dict of numpy (serializable)."""
+    """FrozenDict / jax arrays -> plain dict of numpy (serializable).
+
+    Device leaves start their host copies ASYNC before any is awaited:
+    a per-leaf ``np.asarray`` is one synchronous round trip per leaf,
+    which on a remote/tunneled chip turns a 100-leaf param tree into
+    minutes of serial latency; overlapped it is one latency plus the
+    wire time of the whole tree."""
     try:
         from flax.core import unfreeze
         tree = unfreeze(tree)
     except Exception:
         pass
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array):
+            try:
+                leaf.copy_to_host_async()
+            except Exception:
+                pass  # committed-to-host or non-device arrays
     return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)

@@ -272,16 +272,23 @@ class DeviceEpochCache:
         return total * (3 if shuffle else 2) <= budget_mb * 1e6
 
     def _materialize(self, tensor_dict):
-        """Slice the (steps, batch, ...) epoch into per-batch arrays and
-        BLOCK until they exist. All slicing happens while the device is
-        otherwise idle, so the consumer's steady-state loop dispatches
-        nothing but its own step programs — no mid-stream transfers, and no
-        second program stream interleaving with the step's collectives
-        (concurrent multi-device programs can deadlock a collective
-        rendezvous in the CPU runtime)."""
+        """Slice the (steps, batch, ...) epoch into per-batch arrays.
+
+        The split program is queued AHEAD of any consumer step, so the
+        runtime's program order already guarantees batches exist before a
+        step reads them — the host does not need to wait, and on remote/
+        tunneled chips a synchronous wait here serializes (transfer, then
+        step dispatch) where async overlaps them (~0.5 s per epoch staging
+        on a congested link). The CPU runtime is the exception and DOES
+        block: its collective rendezvous can deadlock when a second
+        multi-device program stream interleaves with step collectives."""
         with self.mesh:
             batches = self._split(tensor_dict, self.steps_per_epoch)
-            jax.block_until_ready(batches)
+            # keyed on the MESH's platform, not default_backend(): a
+            # CPU-device mesh on an accelerator host still runs the CPU
+            # collective runtime and still needs the wait
+            if self.mesh.devices.flat[0].platform == "cpu":
+                jax.block_until_ready(batches)
         return batches
 
     def batches(self, epoch: int = 0):
@@ -326,9 +333,11 @@ class DistributedTrainer:
         # CPU runtime needs it — its collective rendezvous can starve under
         # hundreds of queued async steps. Real TPU runtimes bound their own
         # launch queue, and the readiness probe would cost a host round
-        # trip per step on remote chips.
+        # trip per step on remote chips. Keyed on the MESH's platform
+        # (like DeviceEpochCache._materialize): a CPU-device mesh on an
+        # accelerator host still runs the CPU collective runtime.
         self._inflight: list = []
-        self._throttled = jax.default_backend() == "cpu"
+        self._throttled = self.mesh.devices.flat[0].platform == "cpu"
 
     # -- state -------------------------------------------------------------
     def _full_init_fn(self, init_params_fn: Callable[[], Any]):
