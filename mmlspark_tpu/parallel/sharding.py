@@ -152,6 +152,15 @@ def mesh_spans_processes(mesh: Mesh) -> bool:
     return _spans(mesh)
 
 
+def is_cpu_mesh(mesh: Mesh) -> bool:
+    """True when the mesh runs on the CPU collective runtime — which
+    needs serialized multi-device program streams (its collective
+    rendezvous can deadlock/starve under concurrent or deeply queued
+    programs). Keyed on the MESH's devices, not ``default_backend()``:
+    a CPU-device mesh on an accelerator host is still the CPU runtime."""
+    return mesh.devices.flat[0].platform == "cpu"
+
+
 @lru_cache(maxsize=None)
 def _spans(mesh: Mesh) -> bool:
     pid = jax.process_index()

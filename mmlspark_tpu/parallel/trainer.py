@@ -284,10 +284,7 @@ class DeviceEpochCache:
         multi-device program stream interleaves with step collectives."""
         with self.mesh:
             batches = self._split(tensor_dict, self.steps_per_epoch)
-            # keyed on the MESH's platform, not default_backend(): a
-            # CPU-device mesh on an accelerator host still runs the CPU
-            # collective runtime and still needs the wait
-            if self.mesh.devices.flat[0].platform == "cpu":
+            if is_cpu_mesh(self.mesh):
                 jax.block_until_ready(batches)
         return batches
 
@@ -333,11 +330,9 @@ class DistributedTrainer:
         # CPU runtime needs it — its collective rendezvous can starve under
         # hundreds of queued async steps. Real TPU runtimes bound their own
         # launch queue, and the readiness probe would cost a host round
-        # trip per step on remote chips. Keyed on the MESH's platform
-        # (like DeviceEpochCache._materialize): a CPU-device mesh on an
-        # accelerator host still runs the CPU collective runtime.
+        # trip per step on remote chips.
         self._inflight: list = []
-        self._throttled = self.mesh.devices.flat[0].platform == "cpu"
+        self._throttled = is_cpu_mesh(self.mesh)
 
     # -- state -------------------------------------------------------------
     def _full_init_fn(self, init_params_fn: Callable[[], Any]):
