@@ -1057,8 +1057,12 @@ def config_longctx() -> dict:
     t_fw = _best(rounds, 0)
     toks = steps * B * L / t_fw
     # FLOP count from the reference program: XLA's cost analysis cannot
-    # see inside the Pallas custom call, and the two compute the same math
-    flops = _step_flops(ref_jit, q, k, v)
+    # see inside the Pallas custom call. The dense program computes all
+    # L x L score entries, but causal attention only NEEDS L(L+1)/2 of
+    # them — and the flash kernel actually skips the fully-masked future
+    # blocks (ops/pallas_attention.py) — so credit only the causal-useful
+    # fraction or the flash path's tflops/mfu overstate by ~2x at L=8192.
+    flops = _step_flops(ref_jit, q, k, v) * (L + 1) / (2 * L)
     tflops, mfu = _mfu(toks, flops, B * L)
     ratio = round(_med_ratio(rounds, 1, 0), 4)
     # on a CPU backend full_attention('auto') falls back to the same jnp

@@ -29,7 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mmlspark_tpu.parallel.mesh import mesh_from_config
 from mmlspark_tpu.parallel.sharding import (
-    active_batch_axes, batch_sharding, local_batch_rows,
+    active_batch_axes, batch_sharding, is_cpu_mesh, local_batch_rows,
     mesh_spans_processes, param_shardings, Rules, shard_batch,
 )
 from mmlspark_tpu.utils import config as mmlconfig

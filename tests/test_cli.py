@@ -146,6 +146,76 @@ def test_hosts_contract_env_fallbacks(monkeypatch):
         ("h0:7000", 16, 11)
 
 
+def test_hosts_contract_env_rejects_bad_ints(monkeypatch):
+    """Unexpanded template variables / negatives in the env contract must
+    fail fast, not hang a jax.distributed rendezvous with a bad id."""
+    from mmlspark_tpu.cli import _resolve_hosts
+    monkeypatch.setenv("MMLSPARK_COORDINATOR", "h0:7000")
+    monkeypatch.setenv("MMLSPARK_NUM_PROCESSES", "$(WORKERS)")
+    with pytest.raises(SystemExit, match="not an integer"):
+        _resolve_hosts(_ns())
+    monkeypatch.setenv("MMLSPARK_NUM_PROCESSES", "-4")
+    with pytest.raises(SystemExit, match="must be >= 0"):
+        _resolve_hosts(_ns())
+    # pure-env contract also range-checks (no --hosts branch involved)
+    monkeypatch.setenv("MMLSPARK_NUM_PROCESSES", "4")
+    monkeypatch.setenv("MMLSPARK_PROCESS_ID", "4")
+    with pytest.raises(SystemExit, match="out of range"):
+        _resolve_hosts(_ns())
+
+
+def test_run_autodiscovery_passes_all_none(tmp_path, monkeypatch):
+    """On a real TPU pod nothing is set: the launcher must hand
+    (None, None, None) to initialize_multihost so jax.distributed
+    auto-discovers from the TPU metadata (docs/DEPLOY.md)."""
+    from mmlspark_tpu.parallel import mesh as mesh_mod
+    for var in ("MMLSPARK_COORDINATOR", "MMLSPARK_NUM_PROCESSES",
+                "MMLSPARK_PROCESS_ID", "MMLSPARK_HOST_INDEX"):
+        monkeypatch.delenv(var, raising=False)
+    calls = []
+    monkeypatch.setattr(
+        mesh_mod, "initialize_multihost",
+        lambda coordinator_address=None, num_processes=None,
+        process_id=None: calls.append(
+            (coordinator_address, num_processes, process_id)))
+    script = tmp_path / "prog.py"
+    script.write_text("pass\n")
+    assert main(["run", str(script)]) == 0
+    assert calls == [(None, None, None)]
+
+
+def test_run_hosts_flags_reach_initialize(tmp_path, monkeypatch):
+    """argv -> initialize_multihost pinning for the --hosts branch: the
+    derived (coordinator, num_processes, process_id) triple is exactly
+    what the process group is formed with."""
+    from mmlspark_tpu.parallel import mesh as mesh_mod
+    for var in ("MMLSPARK_COORDINATOR", "MMLSPARK_NUM_PROCESSES",
+                "MMLSPARK_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("MMLSPARK_HOST_INDEX", "1")
+    calls = []
+    monkeypatch.setattr(
+        mesh_mod, "initialize_multihost",
+        lambda coordinator_address=None, num_processes=None,
+        process_id=None: calls.append(
+            (coordinator_address, num_processes, process_id)))
+    script = tmp_path / "prog.py"
+    script.write_text("pass\n")
+    assert main(["run", str(script), "--hosts", "tpu-a,tpu-b,tpu-c",
+                 "--port", "9100"]) == 0
+    assert calls == [("tpu-a:9100", 3, 1)]
+
+
+def test_initialize_multihost_rejects_partial_flags():
+    """Worker flags without a coordinator would train alone while the
+    cluster hangs at the barrier — must refuse."""
+    from mmlspark_tpu.parallel.mesh import initialize_multihost
+    with pytest.raises(ValueError, match="coordinator_address"):
+        initialize_multihost(num_processes=4)
+    with pytest.raises(ValueError, match="coordinator_address"):
+        initialize_multihost(process_id=2)
+
+
 @pytest.mark.slow
 def test_hosts_contract_two_process_launch(tmp_path):
     """The docs/DEPLOY.md §4 command sequence, end to end: two processes

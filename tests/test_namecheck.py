@@ -1,0 +1,107 @@
+"""The static undefined-name gate, enforced from inside the pytest lane.
+
+The reference cannot ship an undefined name: the Scala compiler runs with
+``-Xfatal-warnings -Xlint`` and scalastyle inside ``full-build``
+(/root/reference/src/project/build.scala:47-58, :76-85).  Python has no such
+compiler pass, and exactly this bug class shipped in round 4 (an
+``is_cpu_mesh`` call with no import broke every training-shaped test, the
+bench, and the multichip dryrun).  This test makes the whole repo's name
+resolution part of the default test lane so an un-run refactor can never
+pass tests again.
+"""
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+NAMECHECK = REPO / "tools" / "namecheck.py"
+
+sys.path.insert(0, str(REPO / "tools"))
+import namecheck  # noqa: E402
+
+
+def test_repo_has_no_undefined_names():
+    # no explicit roots: namecheck.DEFAULT_ROOTS is the single source of
+    # truth shared with `tools/runme lint`
+    proc = subprocess.run(
+        [sys.executable, str(NAMECHECK)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, f"undefined names:\n{proc.stdout}{proc.stderr}"
+
+
+def test_default_roots_all_exist_and_missing_root_fails():
+    for root in namecheck.DEFAULT_ROOTS:
+        assert (REPO / root).exists(), f"stale DEFAULT_ROOTS entry: {root}"
+    proc = subprocess.run(
+        [sys.executable, str(NAMECHECK), "definitely_missing_dir"],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode != 0
+    assert "root not found" in proc.stdout
+
+
+def _problems(src: str, tmp_path: Path) -> list[str]:
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(src))
+    return namecheck.check_file(f)
+
+
+def test_catches_the_round4_bug_shape(tmp_path):
+    # a name used in a method but never imported/bound anywhere in the module
+    probs = _problems(
+        """
+        from os.path import join
+
+        class T:
+            def step(self, mesh):
+                if is_cpu_mesh(mesh):
+                    return join("a", "b")
+        """,
+        tmp_path,
+    )
+    assert len(probs) == 1 and "is_cpu_mesh" in probs[0]
+
+
+def test_hoisting_forward_refs_and_scopes_do_not_false_positive(tmp_path):
+    probs = _problems(
+        """
+        from __future__ import annotations
+        import os
+
+        def uses_later() -> Later:
+            g = os.getcwd()
+            return Later(g, helper())
+
+        class Later:
+            def __init__(self, g, h):
+                self.pair = (g, h)
+
+            def m(self):
+                return [x * FACTOR for x in range(3) if x or self.pair]
+
+        def helper():
+            global FACTOR
+            FACTOR = 2
+            y = (z := 1) + z
+            try:
+                import nonexistent_mod as nm
+            except ImportError:
+                nm = None
+            return lambda q=y: (q, nm)
+
+        match [1, 2]:
+            case [a, *rest]:
+                TOTAL = a + len(rest)
+        """,
+        tmp_path,
+    )
+    assert probs == [], probs
+
+
+def test_syntax_error_is_fatal(tmp_path):
+    f = tmp_path / "bad.py"
+    f.write_text("def f(:\n")
+    probs = namecheck.check_file(f)
+    assert len(probs) == 1 and "SYNTAX" in probs[0]

@@ -1,0 +1,270 @@
+#!/usr/bin/env python3
+"""Static undefined-name checker (the pyflakes-F821 class) for the fast lane.
+
+The reference gets this gate for free from the Scala compiler:
+``-Xfatal-warnings -Xlint`` + scalastyle run inside ``full-build``
+(/root/reference/src/project/build.scala:47-58, :76-85) — an undefined name
+there cannot ship.  Python has no compiler pass for it and this image ships
+no linter, so this module re-implements the one rule that matters: every
+``Name`` load must resolve to a binding in an enclosing scope, the module
+scope, or builtins.
+
+Design choices (tuned to never false-positive, at the cost of missing some
+exotic true positives):
+
+- Hoisted binding model: a name bound ANYWHERE in a scope counts as bound for
+  the whole scope (matches Python's static scoping; no use-before-assign
+  analysis).
+- Full-chain lookup including class scopes (Python actually hides class-body
+  names from nested functions; we allow them — a false-negative-only
+  relaxation).
+- ``from x import *`` suppresses reports for that module.
+- ``global x`` registers ``x`` in the module scope (functions may create
+  module globals).
+
+Exit status: 0 = clean, 1 = undefined names found, 2 = syntax error.
+"""
+from __future__ import annotations
+
+import ast
+import builtins
+import sys
+from pathlib import Path
+
+EXTRA_BUILTINS = {
+    "__file__", "__name__", "__doc__", "__package__", "__loader__",
+    "__spec__", "__builtins__", "__debug__", "__class__", "__path__",
+    "__annotations__", "__dict__", "__module__", "__qualname__",
+}
+BUILTIN_NAMES = set(dir(builtins)) | EXTRA_BUILTINS
+
+# The canonical root list for this repo — the single source of truth used by
+# `tools/runme lint`, the in-pytest gate (tests/test_namecheck.py), and a
+# bare `python tools/namecheck.py` run.
+DEFAULT_ROOTS = ["mmlspark_tpu", "tests", "bench.py", "__graft_entry__.py",
+                 "examples", "tools"]
+
+
+def _all_args(args: ast.arguments) -> list[ast.arg]:
+    return (
+        list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        + ([args.vararg] if args.vararg else [])
+        + ([args.kwarg] if args.kwarg else [])
+    )
+
+
+class Scope:
+    __slots__ = ("bindings", "parent", "has_star", "is_comprehension")
+
+    def __init__(self, parent: "Scope | None", is_comprehension: bool = False):
+        self.bindings: set[str] = set()
+        self.parent = parent
+        self.has_star = False
+        self.is_comprehension = is_comprehension
+
+    def chain_has(self, name: str) -> bool:
+        s: Scope | None = self
+        while s is not None:
+            if name in s.bindings or s.has_star:
+                return True
+            s = s.parent
+        return False
+
+
+class Checker(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.module_scope = Scope(None)
+        self.scope = self.module_scope
+        # (name, lineno, col) recorded during the walk, resolved at the end
+        # so that later-in-file bindings (hoisting) resolve earlier loads.
+        self.loads: list[tuple[str, int, int, Scope]] = []
+
+    # -- scope plumbing ----------------------------------------------------
+    def _push(self, is_comprehension: bool = False) -> Scope:
+        self.scope = Scope(self.scope, is_comprehension)
+        return self.scope
+
+    def _pop(self) -> None:
+        assert self.scope.parent is not None
+        self.scope = self.scope.parent
+
+    def _bind(self, name: str) -> None:
+        self.scope.bindings.add(name)
+
+    def _bind_outside_comprehensions(self, name: str) -> None:
+        # walrus targets skip comprehension scopes (PEP 572)
+        s = self.scope
+        while s.is_comprehension and s.parent is not None:
+            s = s.parent
+        s.bindings.add(name)
+
+    # -- bindings ----------------------------------------------------------
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.loads.append((node.id, node.lineno, node.col_offset, self.scope))
+        else:  # Store / Del both create a local binding for the scope
+            self._bind(node.id)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            self._bind(alias.asname or alias.name.split(".")[0])
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        for alias in node.names:
+            if alias.name == "*":
+                self.scope.has_star = True
+            else:
+                self._bind(alias.asname or alias.name)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        for n in node.names:
+            self.module_scope.bindings.add(n)
+            self._bind(n)
+
+    def visit_Nonlocal(self, node: ast.Nonlocal) -> None:
+        for n in node.names:
+            self._bind(n)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.name:
+            self._bind(node.name)
+        self.generic_visit(node)
+
+    def visit_MatchAs(self, node: ast.MatchAs) -> None:
+        if node.name:
+            self._bind(node.name)
+        self.generic_visit(node)
+
+    def visit_MatchStar(self, node: ast.MatchStar) -> None:
+        if node.name:
+            self._bind(node.name)
+
+    def visit_MatchMapping(self, node: ast.MatchMapping) -> None:
+        if node.rest:
+            self._bind(node.rest)
+        self.generic_visit(node)
+
+    def visit_NamedExpr(self, node: ast.NamedExpr) -> None:
+        self.visit(node.value)
+        assert isinstance(node.target, ast.Name)
+        self._bind_outside_comprehensions(node.target.id)
+
+    # -- new scopes --------------------------------------------------------
+    def _visit_function(self, node: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        self._bind(node.name)
+        for dec in node.decorator_list:
+            self.visit(dec)
+        args = node.args
+        for default in list(args.defaults) + [d for d in args.kw_defaults if d]:
+            self.visit(default)
+        for a in _all_args(args):
+            if a.annotation:
+                self.visit(a.annotation)
+        if node.returns:
+            self.visit(node.returns)
+        self._push()
+        for a in _all_args(args):
+            self._bind(a.arg)
+        for stmt in node.body:
+            self.visit(stmt)
+        self._pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        args = node.args
+        for default in list(args.defaults) + [d for d in args.kw_defaults if d]:
+            self.visit(default)
+        self._push()
+        for a in _all_args(args):
+            self._bind(a.arg)
+        self.visit(node.body)
+        self._pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._bind(node.name)
+        for dec in node.decorator_list:
+            self.visit(dec)
+        for base in list(node.bases) + [k.value for k in node.keywords]:
+            self.visit(base)
+        self._push()
+        for stmt in node.body:
+            self.visit(stmt)
+        self._pop()
+
+    def _visit_comprehension(
+        self, node: ast.ListComp | ast.SetComp | ast.GeneratorExp | ast.DictComp
+    ) -> None:
+        # first iterable evaluates in the enclosing scope
+        self.visit(node.generators[0].iter)
+        self._push(is_comprehension=True)
+        for i, gen in enumerate(node.generators):
+            self.visit(gen.target)
+            if i > 0:
+                self.visit(gen.iter)
+            for cond in gen.ifs:
+                self.visit(cond)
+        if isinstance(node, ast.DictComp):
+            self.visit(node.key)
+            self.visit(node.value)
+        else:
+            self.visit(node.elt)
+        self._pop()
+
+    visit_ListComp = _visit_comprehension
+    visit_SetComp = _visit_comprehension
+    visit_GeneratorExp = _visit_comprehension
+    visit_DictComp = _visit_comprehension
+
+    # -- resolution --------------------------------------------------------
+    def undefined(self) -> list[tuple[str, int, int]]:
+        out = []
+        for name, lineno, col, scope in self.loads:
+            if name in BUILTIN_NAMES:
+                continue
+            if not scope.chain_has(name):
+                out.append((name, lineno, col))
+        return out
+
+
+def check_file(path: Path) -> list[str]:
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}:{e.offset}: SYNTAX ERROR: {e.msg}"]
+    checker = Checker()
+    checker.visit(tree)
+    return [
+        f"{path}:{lineno}:{col + 1}: undefined name '{name}'"
+        for name, lineno, col in checker.undefined()
+    ]
+
+
+def main(argv: list[str]) -> int:
+    roots = [Path(a) for a in (argv or DEFAULT_ROOTS)]
+    files: list[Path] = []
+    for r in roots:
+        if r.is_file():
+            files.append(r)
+        elif r.is_dir():
+            files.extend(sorted(r.rglob("*.py")))
+        else:
+            # a missing root must FAIL, not shrink coverage: a renamed or
+            # typo'd directory would otherwise silently disable the gate
+            print(f"namecheck: root not found: {r}")
+            return 2
+    problems: list[str] = []
+    for f in files:
+        problems.extend(check_file(f))
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"namecheck: {len(problems)} problem(s) in {len(files)} files")
+        return 2 if any("SYNTAX" in p for p in problems) else 1
+    print(f"namecheck: OK ({len(files)} files)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
