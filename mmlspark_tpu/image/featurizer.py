@@ -50,6 +50,13 @@ class ImageFeaturizer(HasInputCol, HasOutputCol, Transformer):
         "axis-size dict / Mesh; None = single-device) — model-parallel "
         "featurization for backbones one chip cannot hold; forwarded to "
         "the internal JaxModel", None)
+    computeDtype = StringParam(
+        "computeDtype", "backbone compute + feature wire precision "
+        "(forwarded to the internal JaxModel): 'bfloat16' runs the "
+        "convs/matmuls MXU-native and fetches embeddings at half the "
+        "bytes — the TPU-idiomatic choice for transfer-learning "
+        "features; 'float32' is exact", "float32",
+        domain=("float32", "bfloat16"))
 
     def __init__(self, uid=None, **kwargs):
         kwargs.setdefault("inputCol", "image")
@@ -158,13 +165,14 @@ class ImageFeaturizer(HasInputCol, HasOutputCol, Transformer):
         # one per call would pay the jit compile (20-40s on TPU) every time.
         key = (self.architecture, repr(self.get("architectureArgs")), node,
                self.miniBatchSize, repr(device_pre),
-               repr(self.get("meshSpec")))
+               repr(self.get("meshSpec")), self.get("computeDtype"))
         jm = getattr(self, "_jm_cache", None)
         if jm is None or getattr(self, "_jm_key", None) != key:
             jm = JaxModel(inputCol=tmp_vec, outputCol=self.outputCol,
                           miniBatchSize=self.miniBatchSize,
                           outputNodeName=node,
                           devicePreprocess=device_pre,
+                          computeDtype=self.get("computeDtype"),
                           meshSpec=self.get("meshSpec"))
             jm.set_params(architecture=self.architecture,
                           architectureArgs=self.get("architectureArgs"))

@@ -69,6 +69,15 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         "evaluation passes) then transfers the input ONCE — the "
         "inference face of DeviceEpochCache.", "auto",
         domain=("auto", "on", "off"))
+    computeDtype = StringParam(
+        "computeDtype", "matmul/conv compute precision: 'bfloat16' casts "
+        "float params + activations to bf16 inside the jit (MXU-native) "
+        "AND keeps the fetched output in bf16 on the wire — half the "
+        "device->host bytes, which on remote/tunneled links is the "
+        "scoring bottleneck for wide feature outputs; the emitted column "
+        "is still float32 (cast on host). 'float32' preserves exact "
+        "CNTKModel-parity numerics. Integer inputs (token models) are "
+        "never cast.", "float32", domain=("float32", "bfloat16"))
 
     def set_model(self, architecture: str, params: Optional[Any] = None,
                   seed: int = 0, input_mean=None, input_std=None,
@@ -152,6 +161,12 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         # parameter size and multiplies compile time (or overflows
         # remote-compile request limits outright)
         params = jax.tree_util.tree_map(jnp.asarray, self._state["params"])
+        cdt = (jnp.bfloat16 if self.get("computeDtype") == "bfloat16"
+               else None)
+        if cdt is not None:
+            params = jax.tree_util.tree_map(
+                lambda a: a.astype(cdt)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
         if mesh is not None:
             # model-parallel scoring: params land sharded (tensor/fsdp per
             # the standard rules) ONCE; every batch then streams through
@@ -227,6 +242,15 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
             pre = lambda x: fused(x) if x.dtype == jnp.uint8 else norm(x)
         else:
             pre = norm
+
+        if cdt is not None:
+            # bf16 enters HERE, after the full-precision preprocess
+            # (resize interpolation + normalization stay fp32-exact);
+            # integer token inputs pass through untouched
+            def pre(x, _pre=pre):
+                y = _pre(x)
+                return (y.astype(cdt)
+                        if jnp.issubdtype(y.dtype, jnp.floating) else y)
 
         def bind(jitted):
             if mesh is None:
@@ -327,7 +351,7 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
             lambda: self._build_apply(),
             key=(self.architecture, repr(self.get("architectureArgs")),
                  self.outputNodeName, repr(self.get("devicePreprocess")),
-                 repr(self.get("meshSpec")),
+                 repr(self.get("meshSpec")), self.get("computeDtype"),
                  ))
         bs = self.miniBatchSize
         if mesh is not None:

@@ -221,6 +221,24 @@ def test_image_featurizer_features_and_logits(rng):
             "vit_tiny", num_classes=9, image_size=8, patch=4).transform(f)
 
 
+def test_image_featurizer_compute_dtype_bf16(rng):
+    """computeDtype='bfloat16' (MXU-native backbone + half-width feature
+    wire) must stay close to the fp32 embeddings and emit float32."""
+    f = make_image_frame(rng, n=4, h=20, w=30)
+    outs = {}
+    for cdt in ("float32", "bfloat16"):
+        feat = ImageFeaturizer(cutOutputLayers=1, miniBatchSize=4,
+                               computeDtype=cdt)
+        feat.set_model("vit_tiny", num_classes=9, image_size=8, patch=4,
+                       seed=2)
+        col = feat.transform(f).column("features")
+        assert np.asarray(col).dtype == np.float32
+        outs[cdt] = np.asarray(col)
+    ref = outs["float32"]
+    scale = np.abs(ref).max()
+    np.testing.assert_allclose(outs["bfloat16"], ref, atol=0.05 * scale)
+
+
 def test_image_featurizer_fused_device_resize_matches_host(rng):
     """Uniform uint8 images take the fused path (uint8 wire + on-device
     resize inside the scoring jit); its features must match the host

@@ -73,6 +73,37 @@ def test_jax_model_scores_logits():
     assert out.count() == 10  # padding removed
 
 
+def test_jax_model_compute_dtype_bf16_close_to_fp32():
+    """computeDtype='bfloat16' runs the net MXU-native and ships the
+    output as bf16; the emitted column must still be float32 and close to
+    the fp32 path (embedding-grade tolerance)."""
+    f = make_image_frame(n=12)
+    outs = {}
+    for cdt in ("float32", "bfloat16"):
+        m = JaxModel(inputCol="img", outputCol="o", miniBatchSize=4,
+                     computeDtype=cdt)
+        m.set_model("vit_tiny", num_classes=5, image_size=8, patch=4, seed=3)
+        col = m.transform(f).column("o")
+        assert np.asarray(col).dtype == np.float32
+        outs[cdt] = np.asarray(col)
+    # bf16 matmuls: ~2-3 decimal digits; logits here are O(1)
+    np.testing.assert_allclose(outs["bfloat16"], outs["float32"],
+                               atol=0.15, rtol=0.1)
+    assert not np.array_equal(outs["bfloat16"], outs["float32"]), \
+        "bf16 path produced bit-identical output; cast likely not applied"
+
+
+def test_jax_model_compute_dtype_keeps_token_models_integer():
+    """bf16 mode must not disturb int32 token inputs (cast guard)."""
+    ids = np.arange(24, dtype=np.int32).reshape(2, 12) % 7
+    f = Frame.from_dict({"ids": ids})
+    m = JaxModel(inputCol="ids", outputCol="o", miniBatchSize=2,
+                 computeDtype="bfloat16")
+    m.set_model("textcnn", num_classes=3, vocab_size=8, seq_len=12, seed=0)
+    out = m.transform(f)
+    assert out.count() == 2 and out.schema["o"].dim == 3
+
+
 def test_jax_model_minibatch_padding_consistency():
     """Same outputs whatever the batch size (pad/unpad correctness)."""
     f = make_image_frame(n=7)

@@ -22,7 +22,8 @@ exotic true positives):
 - ``global x`` registers ``x`` in the module scope (functions may create
   module globals).
 
-Exit status: 0 = clean, 1 = undefined names found, 2 = syntax error.
+Exit status: 0 = clean, 1 = undefined names found, 2 = syntax error or a
+missing root path (bad invocation must fail loudly, not shrink coverage).
 """
 from __future__ import annotations
 
