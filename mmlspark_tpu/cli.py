@@ -184,6 +184,56 @@ def cmd_run(args, passthrough: List[str]) -> int:
     return 0
 
 
+def build_pod_argv(args, passthrough: List[str]) -> List[str]:
+    """The ``gcloud compute tpus tpu-vm ssh --worker=all`` argv for a pod
+    launch (docs/DEPLOY.md §2) — every worker runs the IDENTICAL
+    ``mmlspark-tpu run`` command and jax.distributed auto-discovers the
+    process group from the TPU metadata. Split out from cmd_launch_pod so
+    tests can pin the exact constructed argv (the reference's live-cluster
+    E2E — ``_e2e_script_action``/``_e2e_ssh`` in tools/runme/build.sh —
+    verified its HDI script action the expensive way; the argv contract
+    is the hardware-free part)."""
+    import shlex
+
+    def quote_dir(p: str) -> str:
+        # a leading ~ (bare, ~/path, or ~user/path) must stay OUTSIDE the
+        # quotes or the remote shell never tilde-expands it (cd '~/app'
+        # fails where cd ~/app works)
+        if p.startswith("~"):
+            prefix, sep, rest = p.partition("/")
+            if not sep:
+                return prefix          # '~' or '~user'
+            return prefix + "/" + (shlex.quote(rest) if rest else "")
+        return shlex.quote(p)
+
+    inner = ["mmlspark-tpu", "run", args.script]
+    if args.mesh:
+        inner += ["--mesh", args.mesh]
+    if passthrough:
+        inner += ["--"] + list(passthrough)
+    command = "cd " + quote_dir(args.app_dir) + " && " \
+        + " ".join(shlex.quote(a) for a in inner)
+    argv = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", args.name,
+            f"--worker={args.worker}"]
+    if args.zone:
+        argv += ["--zone", args.zone]
+    if args.project:
+        argv += ["--project", args.project]
+    argv += ["--command", command]
+    return argv
+
+
+def cmd_launch_pod(args, passthrough: List[str]) -> int:
+    if args.mesh:
+        _parse_mesh(args.mesh)  # fail fast before touching the cluster
+    argv = build_pod_argv(args, passthrough)
+    if args.dry_run:
+        print(json.dumps(argv))
+        return 0
+    import subprocess
+    return subprocess.call(argv)
+
+
 def cmd_info(args, passthrough) -> int:
     from mmlspark_tpu.parallel.mesh import device_count_summary
     from mmlspark_tpu.utils import config
@@ -246,6 +296,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                        "— e.g. --platform cpu for the virtual-device test "
                        "mesh")
     run_p.set_defaults(fn=cmd_run)
+
+    pod_p = sub.add_parser(
+        "launch-pod",
+        help="run a script on every worker of a TPU pod via gcloud ssh")
+    pod_p.add_argument("name", help="TPU VM / pod slice name")
+    pod_p.add_argument("script", help="script path on the workers")
+    pod_p.add_argument("--mesh", default="",
+                       help="forwarded to `mmlspark-tpu run` on each worker")
+    pod_p.add_argument("--zone", default="")
+    pod_p.add_argument("--project", default="")
+    pod_p.add_argument("--worker", default="all",
+                       help="gcloud --worker selector (default: all)")
+    pod_p.add_argument("--app-dir", default="~/app",
+                       help="directory cd'd into on each worker")
+    pod_p.add_argument("--dry-run", action="store_true",
+                       help="print the gcloud argv as JSON, don't execute")
+    pod_p.set_defaults(fn=cmd_launch_pod)
 
     info_p = sub.add_parser("info", help="device + config inventory")
     info_p.set_defaults(fn=cmd_info)

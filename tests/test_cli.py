@@ -206,6 +206,46 @@ def test_run_hosts_flags_reach_initialize(tmp_path, monkeypatch):
     assert calls == [("tpu-a:9100", 3, 1)]
 
 
+def test_launch_pod_argv_contract(capsys):
+    """The pod-launch gcloud argv (docs/DEPLOY.md §2) pinned end to end:
+    worker selector, zone/project, app dir, mesh pass-through, and
+    shell-safe quoting of script args in the remote --command string."""
+    assert main(["launch-pod", "my-v5e-16", "train.py",
+                 "--mesh", "data=-1,tensor=2", "--zone", "us-west4-a",
+                 "--project", "proj-1", "--app-dir", "/opt/my app",
+                 "--dry-run", "--", "--alpha", "a b"]) == 0
+    argv = json.loads(capsys.readouterr().out)
+    assert argv[:7] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh",
+                        "my-v5e-16", "--worker=all"]
+    assert argv[7:11] == ["--zone", "us-west4-a", "--project", "proj-1"]
+    assert argv[11] == "--command"
+    assert argv[12] == ("cd '/opt/my app' && mmlspark-tpu run train.py "
+                        "--mesh data=-1,tensor=2 -- --alpha 'a b'")
+
+    # minimal form: no zone/project, default worker=all and ~/app
+    assert main(["launch-pod", "pod", "t.py", "--dry-run"]) == 0
+    argv = json.loads(capsys.readouterr().out)
+    # ~ must stay unquoted so the remote shell tilde-expands it
+    assert argv == ["gcloud", "compute", "tpus", "tpu-vm", "ssh", "pod",
+                    "--worker=all", "--command",
+                    "cd ~/app && mmlspark-tpu run t.py"]
+
+    # ~user and spaces after the tilde segment keep expansion AND safety
+    from mmlspark_tpu.cli import build_pod_argv
+    import argparse as _ap
+    ns = _ap.Namespace(name="p", script="t.py", mesh="", worker="all",
+                       zone="", project="", app_dir="~svc/my app")
+    assert build_pod_argv(ns, [])[-1] == \
+        "cd ~svc/'my app' && mmlspark-tpu run t.py"
+    ns.app_dir = "~svc"
+    assert build_pod_argv(ns, [])[-1] == "cd ~svc && mmlspark-tpu run t.py"
+
+    # a bad --mesh fails BEFORE any gcloud contact
+    with pytest.raises(SystemExit):
+        main(["launch-pod", "pod", "t.py", "--mesh", "bogus=2",
+              "--dry-run"])
+
+
 def test_initialize_multihost_rejects_partial_flags():
     """Worker flags without a coordinator would train alone while the
     cluster hangs at the barrier — must refuse."""

@@ -77,6 +77,30 @@ def test_deep_classifier_one_epoch_on_chip():
     assert (pred == y).mean() > 0.8
 
 
+def test_compute_dtype_bf16_scoring_on_chip():
+    """computeDtype='bfloat16' on the real MXU: embeddings must stay close
+    to the fp32 path and the column must emit float32 (the bf16 wire is an
+    implementation detail the user never sees)."""
+    from mmlspark_tpu.core.frame import Frame
+    from mmlspark_tpu.models.jax_model import JaxModel
+
+    rng = np.random.default_rng(7)
+    f = Frame.from_dict(
+        {"img": rng.normal(0, 1, (32, 32 * 32 * 3)).astype(np.float32)},
+        num_partitions=2)
+    outs = {}
+    for cdt in ("float32", "bfloat16"):
+        m = JaxModel(inputCol="img", outputCol="o", miniBatchSize=16,
+                     computeDtype=cdt)
+        m.set_model("resnet20_cifar", num_classes=10, seed=0)
+        col = np.asarray(m.transform(f).column("o"))
+        assert col.dtype == np.float32
+        outs[cdt] = col
+    scale = np.abs(outs["float32"]).max()
+    np.testing.assert_allclose(outs["bfloat16"], outs["float32"],
+                               atol=0.05 * scale)
+
+
 def test_pallas_fused_normalize_matches_numpy():
     """The REAL Mosaic-compiled kernel (interpret=False off-CPU) must match
     the numpy reference bit-tight."""
