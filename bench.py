@@ -817,21 +817,23 @@ def config_image_featurize() -> dict:
         for off in range(0, nb_base * bs_base, bs_base):
             jax.device_get(apply(jnp.asarray(pre[off:off + bs_base])))
 
-    # residency-matched baseline: the SAME resident raw-uint8 input and
-    # the SAME bf16 compute/wire discipline the framework uses, through a
-    # hand-written device resize + pool-feature extraction (the
-    # featurizer's actual job), async dispatch, one fetch — the ratio is
-    # framework bookkeeping only
+    # residency-matched baseline: the SAME resident raw-uint8 stack, the
+    # SAME bf16 compute/wire discipline, and the SAME whole-pass program
+    # shape the framework compiles (lax.map over the batch stack, one
+    # dispatch + one fetch) — hand-written device resize + pool-feature
+    # extraction. Structurally identical device programs make the ratio
+    # pure framework bookkeeping (memo lookups, schema emit); with a
+    # per-batch-loop baseline instead, the ratio wandered 0.85-1.10
+    # run-to-run on nothing but XLA's loop-vs-map scheduling.
     from mmlspark_tpu.models.zoo.resnet import apply_with_intermediates
     from mmlspark_tpu.ops.pallas_preprocess import device_resize_bilinear
     params_bf = jax.tree_util.tree_map(
         lambda a: a.astype(jnp.bfloat16)
         if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
-    dev_u8 = [jnp.asarray(raw[off:off + bs]) for off in range(0, n, bs)]
+    dev_u8 = jax.device_put(raw.reshape(n // bs, bs, src, src, 3))
     jax.block_until_ready(dev_u8)
 
-    @jax.jit
-    def res_jit(p, xu8):
+    def res_body(p, xu8):
         x = device_resize_bilinear(xu8.astype(jnp.float32), dst, dst)
         x = jnp.clip(jnp.round(x), 0.0, 255.0)   # featurizer's requantize
         _, inters = apply_with_intermediates(module, p,
@@ -839,9 +841,11 @@ def config_image_featurize() -> dict:
         return [v for k, v in sorted(inters.items())
                 if k.endswith("pool")][0]
 
+    res_stack = jax.jit(
+        lambda p, stack: jax.lax.map(lambda x: res_body(p, x), stack))
+
     def run_res():
-        outs = [res_jit(params_bf, x) for x in dev_u8]
-        return jax.device_get(jnp.concatenate(outs, axis=0))
+        return np.asarray(jax.device_get(res_stack(params_bf, dev_u8)))
 
     run_base()
     run_res()

@@ -198,12 +198,16 @@ def build_pod_argv(args, passthrough: List[str]) -> List[str]:
     def quote_dir(p: str) -> str:
         # a leading ~ (bare, ~/path, or ~user/path) must stay OUTSIDE the
         # quotes or the remote shell never tilde-expands it (cd '~/app'
-        # fails where cd ~/app works)
+        # fails where cd ~/app works). The unquoted prefix is allowed ONLY
+        # when it is a legal-username shape — anything else (spaces, shell
+        # metacharacters) is fully quoted, trading expansion for safety.
+        import re
         if p.startswith("~"):
             prefix, sep, rest = p.partition("/")
-            if not sep:
-                return prefix          # '~' or '~user'
-            return prefix + "/" + (shlex.quote(rest) if rest else "")
+            if re.fullmatch(r"~[A-Za-z0-9._-]*", prefix):
+                if not sep:
+                    return prefix          # '~' or '~user'
+                return prefix + "/" + (shlex.quote(rest) if rest else "")
         return shlex.quote(p)
 
     inner = ["mmlspark-tpu", "run", args.script]

@@ -372,7 +372,18 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
                 # big frame. Over-budget outputs fall back to per-batch
                 # slices of the resident input with bounded retire windows.
                 from mmlspark_tpu.models import residency
-                out_spec = jax.eval_shape(apply_stack, dev)
+                # eval_shape abstractly traces the whole stack program
+                # (milliseconds for a ResNet-50 — real per-call overhead);
+                # the answer depends only on the input aval and the built
+                # closure, so memoize on exactly those (a rebuilt closure
+                # after set_model/_set_state gets a fresh entry)
+                spec_key = (dev.shape, str(dev.dtype), apply_stack)
+                cached = getattr(self, "_out_spec_cache", None)
+                if cached is not None and cached[0] == spec_key:
+                    out_spec = cached[1]
+                else:
+                    out_spec = jax.eval_shape(apply_stack, dev)
+                    self._out_spec_cache = (spec_key, out_spec)
                 out_bytes = int(np.prod(out_spec.shape)
                                 * out_spec.dtype.itemsize)
                 if self.get("deviceCache") == "on" \
@@ -514,15 +525,26 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         return self._emit(frame, outs)
 
     def _emit(self, frame: Frame, outs: list) -> Frame:
-        """Fetched output batches -> the scored frame column."""
-        out = np.concatenate(outs, axis=0) if outs \
-            else np.zeros((0, 1), np.float32)
+        """Fetched output batches -> the scored frame column.
+
+        Copy-frugal on purpose: a whole-pass transform hands exactly one
+        multi-MB batch here, where a single-element ``np.concatenate``
+        still copies and ``astype(float32)`` copies even when the dtype
+        already matches — two dataset-sized host copies of pure overhead
+        on the resident fast path."""
+        if not outs:
+            out = np.zeros((0, 1), np.float32)
+        elif len(outs) == 1:
+            out = outs[0]
+        else:
+            out = np.concatenate(outs, axis=0)
         if out.ndim == 1:
             out = out[:, None]
+        out = np.asarray(out, np.float32)   # no-copy when already fp32
         col = ColumnSchema(self.outputCol, DType.VECTOR, int(out.shape[1]),
                            metadata={"model_uid": self.uid,
                                      "architecture": self.architecture})
-        return frame.with_column_values(col, out.astype(np.float32))
+        return frame.with_column_values(col, out)
 
     def _transform_sharded(self, frame: Frame, spec, apply, mesh,
                            bs: int) -> Frame:

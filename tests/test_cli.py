@@ -240,6 +240,12 @@ def test_launch_pod_argv_contract(capsys):
     ns.app_dir = "~svc"
     assert build_pod_argv(ns, [])[-1] == "cd ~svc && mmlspark-tpu run t.py"
 
+    # a tilde segment that is NOT a legal-username shape must be fully
+    # quoted — '~x;rm -rf y' must never reach the remote shell unescaped
+    ns.app_dir = "~x;rm -rf y/app"
+    assert build_pod_argv(ns, [])[-1] == \
+        "cd '~x;rm -rf y/app' && mmlspark-tpu run t.py"
+
     # a bad --mesh fails BEFORE any gcloud contact
     with pytest.raises(SystemExit):
         main(["launch-pod", "pod", "t.py", "--mesh", "bogus=2",
