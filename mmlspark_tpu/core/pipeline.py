@@ -39,6 +39,10 @@ class PipelineStage(Params):
 
     def _set_state(self, state: Dict[str, Any]) -> None:
         self._jit_cache = None  # compiled closures are stale once state changes
+        # caches derived FROM a compiled closure (e.g. JaxModel's
+        # eval_shape memo keys on the closure object) must die with it, or
+        # they pin the old closure — and the whole param tree it captured
+        self._out_spec_cache = None
         if state:
             self._state = state
 
