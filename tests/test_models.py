@@ -179,6 +179,44 @@ def test_jax_model_device_cache_auto_respects_budget():
     residency.clear()
 
 
+def test_jax_model_resident_windowed_output_path():
+    """Resident INPUT whose OUTPUT stack is over budget takes the windowed
+    path: per-batch device slices, outputs retired in bounded windows —
+    results identical to the streaming loop. 42 batches cross the
+    retire window (32) and the in-flight bound (8)."""
+    from mmlspark_tpu.models import residency
+    from mmlspark_tpu.utils import config
+    residency.clear()
+    f = make_image_frame(n=83)
+
+    def build(cache):
+        m = JaxModel(inputCol="img", outputCol="o", miniBatchSize=2,
+                     outputNodeName="pool", deviceCache=cache)
+        m.set_model("vit_tiny", num_classes=4, image_size=8, patch=4,
+                    seed=1)
+        return m
+    # input stack: 84*192*4 B = 65 KB; pool output: 84*192*4 = 65 KB.
+    # Budget 0.2 MB: input*2 (131 KB) fits, (input+output)*2 (258 KB)
+    # does not -> resident windowed.
+    config.set("runtime.device_cache_mb", 0.2)
+    try:
+        m = build("auto")
+        hits = []
+        orig = m._transform_resident_windowed
+        m._transform_resident_windowed = \
+            lambda *a, **k: (hits.append(1), orig(*a, **k))[1]
+        windowed = m.transform(f)
+        assert hits, "expected the windowed branch, got whole-pass"
+        assert residency.stats()["total_uploads"] == 1  # input went up
+    finally:
+        config.unset("runtime.device_cache_mb")
+    streamed = build("off").transform(f)
+    assert residency.stats()["total_uploads"] == 1      # off: no new upload
+    np.testing.assert_allclose(windowed.column("o"), streamed.column("o"),
+                               atol=1e-5)
+    residency.clear()
+
+
 def test_jax_model_output_node_selection():
     f = make_image_frame(n=4)
     m = JaxModel(inputCol="img", outputCol="feat", miniBatchSize=4,
