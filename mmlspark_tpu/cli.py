@@ -251,6 +251,15 @@ def cmd_info(args, passthrough) -> int:
     return 0
 
 
+def cmd_check(args, passthrough) -> int:
+    """Static reliability lint (urlopen-without-timeout, swallowed
+    excepts) over the installed package, or explicit roots."""
+    from mmlspark_tpu.reliability import lint
+    roots = args.roots or [os.path.dirname(
+        os.path.abspath(__import__("mmlspark_tpu").__file__))]
+    return lint.main(roots)
+
+
 def cmd_bench(args, passthrough) -> int:
     path = os.path.join(os.getcwd(), "bench.py")
     if not os.path.exists(path):
@@ -323,6 +332,13 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     bench_p = sub.add_parser("bench", help="run ./bench.py")
     bench_p.set_defaults(fn=cmd_bench)
+
+    check_p = sub.add_parser(
+        "check", help="static reliability lint (timeouts, swallowed excepts)")
+    check_p.add_argument("roots", nargs="*",
+                         help="files/dirs to lint (default: the installed "
+                         "mmlspark_tpu package)")
+    check_p.set_defaults(fn=cmd_check)
 
     args = parser.parse_args(argv)
     return args.fn(args, passthrough)

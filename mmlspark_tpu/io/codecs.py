@@ -168,8 +168,8 @@ def decode_image(data: bytes) -> Optional[np.ndarray]:
             out = native_decode_png(data)
             if out is not None:
                 return out
-        except Exception:
-            pass
+        except (ImportError, OSError, RuntimeError):
+            pass  # no native build; the pure-python decoder below covers it
         return decode_png(data)
     if data[:3] == b"\xff\xd8\xff":  # JPEG via native bridge
         try:

@@ -29,6 +29,7 @@ import numpy as np
 from mmlspark_tpu.core.frame import Frame
 from mmlspark_tpu.core.schema import ColumnSchema, DType, ImageValue, Schema
 from mmlspark_tpu.io.codecs import decode_image
+from mmlspark_tpu.reliability.faults import fault_site
 
 
 def _list_files(path: str, recursive: bool) -> List[str]:
@@ -100,10 +101,11 @@ def iter_binary_entries(path: str, recursive: bool = False,
                 # zip entries are themselves subject to the sample ratio
                 # (reference ZipIterator seeded sampling)
                 for n in _sample(names, sample_ratio, seed):
-                    yield f"{f}/{n}", z.read(n)
+                    yield f"{f}/{n}", fault_site("readers.read",
+                                                 payload=z.read(n))
         else:
             with open(f, "rb") as fh:
-                yield f, fh.read()
+                yield f, fault_site("readers.read", payload=fh.read())
 
 
 def stream_binary_files(path: str, recursive: bool = False,

@@ -20,9 +20,12 @@ import json
 import os
 import urllib.request
 from dataclasses import asdict, dataclass, field
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Union
 
 import numpy as np
+
+from mmlspark_tpu.reliability.faults import fault_site
+from mmlspark_tpu.reliability.retry import RetryPolicy
 
 
 @dataclass
@@ -129,23 +132,72 @@ class LocalRepo(Repository):
 
 
 class HttpRepo(Repository):
-    """Remote repository: <base>/MANIFEST lists schema JSON, one per line."""
+    """Remote repository: <base>/MANIFEST lists schema JSON, one per line.
 
-    def __init__(self, base_url: str, cache: LocalRepo):
+    Hardened fetch path (reliability subsystem): every ``urlopen`` carries a
+    timeout, MANIFEST and model fetches run under a :class:`RetryPolicy`,
+    payloads land in a ``.tmp`` file that is sha256-verified (when the
+    schema carries a hash) BEFORE ``os.replace`` into the cache — a
+    truncated or corrupt transfer is retried, never cached, and a crash
+    mid-download leaves no partial file at the cache path. A cached file
+    that no longer matches its hash (torn write from a pre-hardening
+    client, bitrot) is re-fetched instead of erroring forever.
+    """
+
+    def __init__(self, base_url: str, cache: Union[LocalRepo, str],
+                 timeout: Optional[float] = None,
+                 retry: Optional["RetryPolicy"] = None):
+        from mmlspark_tpu.utils import config
         self.base_url = base_url.rstrip("/")
-        self.cache = cache
+        self.cache = LocalRepo(cache) if isinstance(cache, str) else cache
+        self.timeout = (float(config.get("reliability.http_timeout"))
+                        if timeout is None else timeout)
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=int(config.get("reliability.max_attempts")),
+            base_delay=float(config.get("reliability.base_delay")),
+            name="downloader")
+
+    def _fetch(self, url: str) -> bytes:
+        fault_site("downloader.fetch")
+        with urllib.request.urlopen(url, timeout=self.timeout) as r:
+            data = r.read()
+        return fault_site("downloader.payload", payload=data)
 
     def list_schemas(self) -> List[ModelSchema]:
-        with urllib.request.urlopen(f"{self.base_url}/MANIFEST") as r:
-            lines = r.read().decode("utf-8").splitlines()
+        fault_site("downloader.manifest")
+        data = self.retry.call(self._fetch, f"{self.base_url}/MANIFEST")
+        lines = data.decode("utf-8").splitlines()
         return [ModelSchema.from_json(l) for l in lines if l.strip()]
+
+    def _download(self, url: str, schema: ModelSchema, path: str) -> None:
+        """One fetch attempt: tmp file -> sha256 verify -> atomic replace.
+        A hash mismatch raises IOError (retryable: it means a truncated or
+        corrupted transfer) and leaves the cache untouched."""
+        data = self._fetch(url)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            if schema.hash:
+                actual = sha256_file(tmp)
+                if actual != schema.hash:
+                    raise IOError(
+                        f"sha256 mismatch downloading {schema.name} "
+                        f"({len(data)} bytes): {actual} != {schema.hash}")
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
 
     def get_model_path(self, schema: ModelSchema) -> str:
         path = os.path.join(self.cache.root, f"{schema.name}.npz")
-        if not os.path.exists(path):
+        cached_ok = os.path.exists(path) and (
+            not schema.hash or sha256_file(path) == schema.hash)
+        if not cached_ok:
             url = schema.uri or f"{self.base_url}/{schema.name}.npz"
-            with urllib.request.urlopen(url) as r, open(path, "wb") as f:
-                f.write(r.read())
+            self.retry.call(self._download, url, schema, path)
             with open(os.path.join(self.cache.root,
                                    f"{schema.name}.meta"), "w") as f:
                 f.write(schema.to_json())

@@ -607,12 +607,12 @@ def _to_plain(tree):
     try:
         from flax.core import unfreeze
         tree = unfreeze(tree)
-    except Exception:
-        pass
+    except (ImportError, TypeError, ValueError):
+        pass  # no flax, or already a plain container
     for leaf in jax.tree_util.tree_leaves(tree):
         if isinstance(leaf, jax.Array):
             try:
                 leaf.copy_to_host_async()
-            except Exception:
+            except (RuntimeError, ValueError):
                 pass  # committed-to-host or non-device arrays
     return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)

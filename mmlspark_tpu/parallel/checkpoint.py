@@ -24,9 +24,15 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
+
+from mmlspark_tpu.reliability.faults import fault_site
+from mmlspark_tpu.utils.logging import get_logger
+
+_LOG = get_logger("parallel.checkpoint")
 
 
 class TrainCheckpointer:
@@ -36,10 +42,15 @@ class TrainCheckpointer:
         import orbax.checkpoint as ocp
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
-        self._mgr = ocp.CheckpointManager(
+        self._max_to_keep = max_to_keep
+        self._closed = False
+        self._mgr = self._make_manager()
+
+    def _make_manager(self):
+        return self._ocp.CheckpointManager(
             self.directory,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True))
+            options=self._ocp.CheckpointManagerOptions(
+                max_to_keep=self._max_to_keep, create=True))
 
     # -- write --------------------------------------------------------------
     def save(self, state: Any, step: Optional[int] = None,
@@ -47,10 +58,26 @@ class TrainCheckpointer:
         """Save (async by default); step defaults to state['step']."""
         if step is None:
             step = int(jax.device_get(state["step"]))
+        stale = os.path.join(self.directory, str(step))
+        if os.path.isdir(stale):
+            # A dead run's in-flight save for this step landed after restore
+            # listed the committed steps (or tore mid-write). The state being
+            # written now was regenerated deterministically from an older
+            # checkpoint, so it supersedes the leftover; orbax refuses to
+            # overwrite, so clobber it and refresh the cached step list.
+            _LOG.warning("save(%d): removing stale step dir %s", step, stale)
+            shutil.rmtree(stale)
+            self.reload()
+        fault_site("checkpoint.save")
         self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+        fault_site("checkpoint.save.commit")
         if wait:
             self._mgr.wait_until_finished()
         return step
+
+    def wait(self) -> None:
+        """Block until any in-flight async save has committed."""
+        self._mgr.wait_until_finished()
 
     def maybe_save(self, state: Any, every: int, step: int,
                    wait: bool = False) -> Optional[int]:
@@ -104,6 +131,7 @@ class TrainCheckpointer:
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.directory}")
+        fault_site("checkpoint.restore")
         abstract, shardings = trainer.abstract_state(init_params_fn)
         target = jax.tree_util.tree_map(
             lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
@@ -123,6 +151,48 @@ class TrainCheckpointer:
             return trainer.init(init_params_fn), False
         return self.restore(trainer, init_params_fn), True
 
+    # -- recovery -----------------------------------------------------------
+    def quarantine_step(self, step: int) -> str:
+        """Move a bad step's directory aside (``corrupt-<step>``: non-numeric
+        name, so orbax no longer lists it) and reload the manager so
+        ``latest_step``/``all_steps`` reflect the removal. The data is
+        preserved for forensics, not deleted. Returns the quarantine path."""
+        src = os.path.join(self.directory, str(step))
+        dst = os.path.join(self.directory, f"corrupt-{step}")
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = os.path.join(self.directory, f"corrupt-{step}.{n}")
+        if os.path.exists(src):
+            os.rename(src, dst)
+        else:
+            _LOG.warning("quarantine_step(%d): %s does not exist", step, src)
+        self.reload()
+        return dst
+
+    def reload(self) -> None:
+        """Recreate the orbax manager, picking up external directory changes
+        (quarantined steps, another process's saves). The manager caches its
+        step list, so mutations behind its back need this."""
+        try:
+            self._mgr.close()
+        except Exception as e:
+            # a wedged manager must not block recovery; the replacement
+            # manager supersedes it either way
+            _LOG.warning("reload: closing old manager failed (%s: %s)",
+                         type(e).__name__, e)
+        self._mgr = self._make_manager()
+        self._closed = False
+
     def close(self) -> None:
-        self._mgr.wait_until_finished()
-        self._mgr.close()
+        """Idempotent close. A second call is a no-op; the FIRST call still
+        surfaces async-save errors from ``wait_until_finished`` (a failed
+        background save must not vanish into interpreter shutdown), while
+        the manager is released either way."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._mgr.wait_until_finished()
+        finally:
+            self._mgr.close()

@@ -27,7 +27,7 @@ from typing import Any, Callable, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from mmlspark_tpu.parallel.sharding import shard_map_compat as shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mmlspark_tpu.parallel.sharding import active_batch_axes

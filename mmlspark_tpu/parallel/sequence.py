@@ -31,10 +31,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from mmlspark_tpu.parallel.sharding import active_batch_axes
+from mmlspark_tpu.parallel.sharding import (
+    active_batch_axes, shard_map_compat as shard_map,
+)
 
 
 def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,

@@ -53,6 +53,26 @@ DEFAULT_RULES: List[Tuple[str, P]] = [
 # authoritative as written.
 _GENERIC_PATTERNS = {r".*kernel", r".*"}
 
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.5
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs, check_vma=None):
+    """``shard_map`` across jax versions: the function moved from
+    ``jax.experimental.shard_map`` to top-level, and the replication-check
+    kwarg renamed ``check_rep`` -> ``check_vma`` along the way. The one
+    call shape sequence/pipeline parallel need, spelled once."""
+    import inspect
+    kwargs = {}
+    if check_vma is not None:
+        params = inspect.signature(_shard_map).parameters
+        key = "check_vma" if "check_vma" in params else "check_rep"
+        kwargs[key] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)
+
 
 def _path_str(path) -> str:
     parts = []

@@ -28,6 +28,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mmlspark_tpu.parallel.mesh import mesh_from_config
+from mmlspark_tpu.reliability.faults import fault_site
 from mmlspark_tpu.parallel.sharding import (
     active_batch_axes, batch_sharding, is_cpu_mesh, local_batch_rows,
     mesh_spans_processes, param_shardings, Rules, shard_batch,
@@ -419,6 +420,9 @@ class DistributedTrainer:
             donate_argnums=(0,))
 
     def train_step(self, state, batch, rng) -> Tuple[Any, Dict[str, jax.Array]]:
+        # reliability hook: a FaultPlan can kill the Nth step to reproduce a
+        # preemption bit-for-bit (a no-op global read when no plan is active)
+        fault_site("trainer.train_step")
         if self._train_step is None:
             if self._state_shardings is None:
                 raise RuntimeError("call init() before train_step()")

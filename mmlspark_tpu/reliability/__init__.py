@@ -1,0 +1,26 @@
+"""Reliability subsystem: retry/backoff, deterministic fault injection, and
+crash-safe training (ISSUE 1).
+
+- :mod:`mmlspark_tpu.reliability.retry` — :class:`RetryPolicy`, the shared
+  exponential-backoff primitive (deterministic jitter, deadline, retryable
+  predicate);
+- :mod:`mmlspark_tpu.reliability.faults` — :func:`fault_site` hooks +
+  :class:`FaultPlan`, bit-for-bit reproducible failure injection;
+- :mod:`mmlspark_tpu.reliability.resilient` — :class:`ResilientTrainLoop`,
+  the crash-safe trainer/checkpointer driver with corrupt-checkpoint
+  fallback;
+- :mod:`mmlspark_tpu.reliability.lint` — the static ``urlopen``-timeout /
+  swallowed-except gate behind ``mmlspark-tpu check``.
+"""
+from mmlspark_tpu.reliability.faults import (
+    FaultPlan, FaultSpec, InjectedFault, active_plan, fault_site,
+)
+from mmlspark_tpu.reliability.resilient import ResilientTrainLoop
+from mmlspark_tpu.reliability.retry import (
+    Attempt, RetryPolicy, default_retryable,
+)
+
+__all__ = [
+    "Attempt", "FaultPlan", "FaultSpec", "InjectedFault", "RetryPolicy",
+    "ResilientTrainLoop", "active_plan", "default_retryable", "fault_site",
+]

@@ -1,0 +1,149 @@
+"""Deterministic fault injection: named sites + an Nth-hit trigger plan.
+
+The reference's failure story was an exit-code check on the CNTK subprocess
+(SURVEY.md §5) — nothing reproduced a failure. Here failure REPRODUCTION is
+the primitive: production code threads zero-cost ``fault_site("name")``
+hooks through its crash-relevant points (downloader fetches, checkpoint
+save/restore, reader I/O, the train step), and a test installs a
+:class:`FaultPlan` that triggers an exact action on the exact Nth hit of a
+site. Crash-mid-download, crash-mid-checkpoint-write, and transient network
+errors replay bit-for-bit — no monkeypatching, no sleeps, no flakes.
+
+Instrumented sites (grep for ``fault_site(`` to confirm the live list):
+
+- ``downloader.manifest`` / ``downloader.fetch`` — before each urlopen
+- ``downloader.payload``  — carries the fetched bytes (truncatable)
+- ``checkpoint.save``     — before the orbax save dispatch
+- ``checkpoint.save.commit`` — after dispatch, before the commit wait
+- ``checkpoint.restore``  — before the orbax restore
+- ``readers.read``        — carries each binary file/zip-entry payload
+- ``trainer.train_step``  — before each sharded train step
+
+Usage::
+
+    with FaultPlan(FaultSpec("checkpoint.save", on_hit=3)):
+        run_training()          # 3rd checkpoint save raises InjectedFault
+
+With no plan installed, ``fault_site`` is a single global read — cheap
+enough for the train-step hot path.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple, Type, Union
+
+from mmlspark_tpu.utils.logging import get_logger
+
+_LOG = get_logger("reliability.faults")
+_LOCK = threading.Lock()
+_ACTIVE: Optional["FaultPlan"] = None
+
+
+class InjectedFault(RuntimeError):
+    """The default exception a triggered ``raise`` fault throws."""
+
+
+def fault_site(name: str, payload: Any = None) -> Any:
+    """Mark a named fault-injection point.
+
+    Returns ``payload`` unchanged (possibly transformed by a triggered
+    ``truncate`` fault) or raises per the active :class:`FaultPlan`. A
+    no-op returning ``payload`` when no plan is installed.
+    """
+    plan = _ACTIVE
+    if plan is None:
+        return payload
+    return plan.hit(name, payload)
+
+
+def active_plan() -> Optional["FaultPlan"]:
+    return _ACTIVE
+
+
+@dataclass
+class FaultSpec:
+    """One trigger rule: fire ``action`` on hits ``on_hit`` through
+    ``on_hit + times - 1`` (1-based) of ``site``.
+
+    Actions: ``"raise"`` throws ``exc`` (an instance, an exception class,
+    or None for :class:`InjectedFault`); ``"truncate"`` keeps the first
+    ``fraction`` of the site's payload (simulating a cut connection or
+    partial write); ``"delay"`` sleeps ``delay`` seconds (simulating a
+    stalled link, for timeout paths).
+    """
+
+    site: str
+    on_hit: int = 1
+    times: int = 1
+    action: str = "raise"
+    exc: Union[BaseException, Type[BaseException], None] = None
+    fraction: float = 0.5
+    delay: float = 0.0
+
+    def triggers(self, n: int) -> bool:
+        return self.on_hit <= n < self.on_hit + self.times
+
+    def make_exc(self, site: str, n: int) -> BaseException:
+        if self.exc is None:
+            return InjectedFault(f"injected fault at {site} (hit {n})")
+        if isinstance(self.exc, type):
+            return self.exc(f"injected fault at {site} (hit {n})")
+        return self.exc
+
+
+class FaultPlan:
+    """Process-wide deterministic fault schedule (context manager).
+
+    Counts hits per site under a lock (deterministic for any serial code
+    path) and applies every matching :class:`FaultSpec` in order. Plans do
+    not nest — a second concurrent plan would make hit counts ambiguous, so
+    entering while one is active raises. ``triggered`` records each fired
+    ``(site, hit, action)`` for test assertions; ``sleep`` is injectable so
+    delay faults don't slow the suite.
+    """
+
+    def __init__(self, *specs: FaultSpec,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.specs: List[FaultSpec] = list(specs)
+        self.hits: Dict[str, int] = {}
+        self.triggered: List[Tuple[str, int, str]] = []
+        self._sleep = sleep
+
+    def __enter__(self) -> "FaultPlan":
+        global _ACTIVE
+        with _LOCK:
+            if _ACTIVE is not None:
+                raise RuntimeError(
+                    "a FaultPlan is already active; plans do not nest")
+            _ACTIVE = self
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        global _ACTIVE
+        with _LOCK:
+            _ACTIVE = None
+        return False
+
+    def hit(self, name: str, payload: Any = None) -> Any:
+        with _LOCK:
+            n = self.hits.get(name, 0) + 1
+            self.hits[name] = n
+        for spec in self.specs:
+            if spec.site != name or not spec.triggers(n):
+                continue
+            self.triggered.append((name, n, spec.action))
+            _LOG.info("fault %r fired at %s (hit %d)", spec.action, name, n)
+            if spec.action == "delay":
+                self._sleep(spec.delay)
+            elif spec.action == "truncate":
+                if payload is None:
+                    raise InjectedFault(
+                        f"truncate fault at payload-less site {name}")
+                payload = payload[:int(len(payload) * spec.fraction)]
+            elif spec.action == "raise":
+                raise spec.make_exc(name, n)
+            else:
+                raise ValueError(f"unknown fault action {spec.action!r}")
+        return payload

@@ -1,0 +1,114 @@
+"""Static reliability lint: the two bug classes this subsystem exists for.
+
+Rule 1 — ``urlopen(...)`` without an explicit ``timeout=``: a stalled
+connection hangs the caller forever (the pre-reliability downloader did
+exactly this on MANIFEST and model fetches).
+
+Rule 2 — ``except:`` (bare) or ``except Exception: pass`` / ``except
+BaseException: pass``: a swallowed error turns a crash into silent
+corruption — the failure mode the fault-injection harness exists to make
+reproducible, and the one a reliability subsystem must not ship.
+
+Shared core for ``tools/check_reliability.py`` (standalone CLI),
+``mmlspark-tpu check`` (installed CLI), and the in-pytest gate
+(tests/test_reliability_lint.py) — same single source of truth pattern as
+``tools/namecheck.py``.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Sequence, Union
+
+# The canonical scope: production code only. tests/ legitimately use broad
+# excepts in fixtures; examples/ and tools/ are not on the serving path.
+DEFAULT_ROOTS = ["mmlspark_tpu"]
+
+
+def _is_urlopen(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Name) and f.id == "urlopen") or \
+        (isinstance(f, ast.Attribute) and f.attr == "urlopen")
+
+
+def _catches_everything(node: ast.expr) -> bool:
+    """Does this except clause name Exception/BaseException (alone or in a
+    tuple)?"""
+    names = node.elts if isinstance(node, ast.Tuple) else [node]
+    return any(isinstance(n, ast.Name)
+               and n.id in ("Exception", "BaseException") for n in names)
+
+
+def check_source(src: str, filename: str = "<src>") -> List[str]:
+    """Return ``"file:line: message"`` problems for one module's source."""
+    problems: List[str] = []
+    tree = ast.parse(src, filename=filename)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_urlopen(node):
+            has_timeout = any(kw.arg == "timeout" for kw in node.keywords)
+            has_star_kwargs = any(kw.arg is None for kw in node.keywords)
+            # positional signature is urlopen(url, data, timeout, ...):
+            # a third positional arg IS the timeout
+            has_positional = len(node.args) >= 3
+            if not (has_timeout or has_star_kwargs or has_positional):
+                problems.append(
+                    f"{filename}:{node.lineno}: urlopen() without timeout= "
+                    "(a stalled connection hangs forever)")
+        elif isinstance(node, ast.ExceptHandler):
+            if node.type is None:
+                problems.append(
+                    f"{filename}:{node.lineno}: bare `except:` (swallows "
+                    "SystemExit/KeyboardInterrupt; name the exceptions)")
+            elif _catches_everything(node.type) \
+                    and len(node.body) == 1 \
+                    and isinstance(node.body[0], ast.Pass):
+                problems.append(
+                    f"{filename}:{node.lineno}: `except Exception: pass` "
+                    "(silently swallowed error; narrow it or handle it)")
+    return problems
+
+
+def check_file(path: Union[str, Path]) -> List[str]:
+    path = Path(path)
+    try:
+        src = path.read_text(encoding="utf-8")
+    except (OSError, UnicodeDecodeError) as e:
+        return [f"{path}: unreadable ({e})"]
+    try:
+        return check_source(src, filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error ({e.msg})"]
+
+
+def check_roots(roots: Sequence[Union[str, Path]],
+                base: Union[str, Path, None] = None) -> List[str]:
+    """Lint every ``.py`` under each root (a file or a directory).
+
+    A missing root is itself a problem — a bad invocation must fail loudly,
+    not silently shrink coverage (the namecheck.py convention).
+    """
+    problems: List[str] = []
+    base = Path(base) if base is not None else Path.cwd()
+    for root in roots:
+        p = Path(root)
+        if not p.is_absolute():
+            p = base / p
+        if not p.exists():
+            problems.append(f"{root}: root not found")
+            continue
+        files = [p] if p.is_file() else sorted(p.rglob("*.py"))
+        for f in files:
+            problems.extend(check_file(f))
+    return problems
+
+
+def main(argv: Sequence[str] = ()) -> int:
+    roots = list(argv) or DEFAULT_ROOTS
+    problems = check_roots(roots)
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"check_reliability: {len(problems)} problem(s)")
+        return 1
+    print(f"check_reliability: clean ({', '.join(map(str, roots))})")
+    return 0

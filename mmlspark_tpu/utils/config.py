@@ -29,6 +29,10 @@ _DEFAULTS: Dict[str, Any] = {
     # funneling through single-threaded numpy sorts); on remote/tunneled
     # devices the transfer dominates — raise (or set huge) there.
     "evaluate.device_rows": 1_000_000,
+    # reliability (retry/backoff + network timeouts; reliability/ package)
+    "reliability.http_timeout": 30.0,  # seconds per urlopen (downloader)
+    "reliability.max_attempts": 3,     # default RetryPolicy attempt cap
+    "reliability.base_delay": 0.2,     # first backoff delay (seconds)
     # logging
     "logging.level": "INFO",
     "logging.metrics_every": 0,       # default train-metric log cadence (steps)

@@ -1,0 +1,420 @@
+"""Reliability subsystem tests: retry/backoff, deterministic fault
+injection, crash-safe download, and crash-safe checkpoint recovery.
+
+The acceptance pair from ISSUE 1 lives here:
+
+- a run killed MID-CHECKPOINT-WRITE via ``FaultPlan`` restarts and finishes
+  with params bit-identical to an uninterrupted run;
+- a run whose LATEST checkpoint is corrupted on disk resumes from the
+  previous step (quarantining the bad one) instead of crashing.
+"""
+import functools
+import http.server
+import os
+import threading
+import urllib.error
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from mmlspark_tpu.models.downloader import (
+    HttpRepo, LocalRepo, ModelSchema, sha256_file,
+)
+from mmlspark_tpu.parallel.checkpoint import TrainCheckpointer
+from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+from mmlspark_tpu.parallel.trainer import DistributedTrainer
+from mmlspark_tpu.reliability import (
+    FaultPlan, FaultSpec, InjectedFault, RetryPolicy, ResilientTrainLoop,
+    default_retryable, fault_site,
+)
+
+# -- retry primitives --------------------------------------------------------
+
+_NOSLEEP = dict(sleep=lambda s: None)
+
+
+def test_retry_transient_then_success_counts_attempts():
+    calls = {"n": 0}
+    slept = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=5, base_delay=0.1, sleep=slept.append)
+    assert policy.call(flaky) == "ok"
+    assert calls["n"] == 3
+    assert len(slept) == 2
+    assert slept[1] > slept[0]  # exponential
+
+
+def test_retry_backoff_is_deterministic_and_capped():
+    a = RetryPolicy(base_delay=0.1, max_delay=1.0, jitter=0.2, seed=7)
+    b = RetryPolicy(base_delay=0.1, max_delay=1.0, jitter=0.2, seed=7)
+    for attempt in range(1, 10):
+        assert a.delay(attempt) == b.delay(attempt)  # no global RNG
+        assert a.delay(attempt) <= 1.0 * 1.2 + 1e-9  # cap * (1 + jitter)
+    assert RetryPolicy(seed=1).delay(1) != RetryPolicy(seed=2).delay(1)
+
+
+def test_retry_non_retryable_propagates_immediately():
+    calls = {"n": 0}
+
+    @RetryPolicy(max_attempts=5, **_NOSLEEP)
+    def boom():
+        calls["n"] += 1
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError):
+        boom()
+    assert calls["n"] == 1
+
+
+def test_retry_exhaustion_raises_last_error():
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise OSError(f"fail {calls['n']}")
+
+    with pytest.raises(OSError, match="fail 3"):
+        RetryPolicy(max_attempts=3, **_NOSLEEP).call(always)
+    assert calls["n"] == 3
+
+
+def test_retry_deadline_gives_up_early():
+    now = {"t": 0.0}
+
+    def clock():
+        return now["t"]
+
+    def sleep(s):
+        now["t"] += s
+
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        now["t"] += 10.0  # each attempt burns 10s
+        raise OSError("slow fail")
+
+    policy = RetryPolicy(max_attempts=10, base_delay=1.0, deadline=25.0,
+                         sleep=sleep, clock=clock)
+    with pytest.raises(OSError):
+        policy.call(always)
+    assert calls["n"] < 10  # stopped on deadline, not attempt cap
+
+
+def test_retry_attempts_context_manager_loop():
+    calls = {"n": 0}
+    result = None
+    for attempt in RetryPolicy(max_attempts=3, **_NOSLEEP).attempts():
+        with attempt:
+            calls["n"] += 1
+            if calls["n"] < 2:
+                raise ConnectionError("reset")
+            result = "done"
+    assert result == "done" and calls["n"] == 2
+
+
+def test_default_retryable_http_codes():
+    def http_err(code):
+        return urllib.error.HTTPError("http://x", code, "m", None, None)
+
+    assert not default_retryable(http_err(404))
+    assert default_retryable(http_err(429))
+    assert default_retryable(http_err(503))
+    assert default_retryable(urllib.error.URLError("unreachable"))
+    assert default_retryable(TimeoutError())
+    assert not default_retryable(KeyError("nope"))
+
+
+# -- fault injection harness -------------------------------------------------
+
+def test_fault_site_noop_without_plan():
+    assert fault_site("nowhere") is None
+    assert fault_site("nowhere", payload=b"abc") == b"abc"
+
+
+def test_fault_plan_triggers_exact_nth_hit():
+    with FaultPlan(FaultSpec("s", on_hit=3)) as plan:
+        fault_site("s")
+        fault_site("s")
+        with pytest.raises(InjectedFault, match="hit 3"):
+            fault_site("s")
+        fault_site("s")  # hit 4: past the window, no trigger
+        assert plan.hits == {"s": 4}
+        assert plan.triggered == [("s", 3, "raise")]
+
+
+def test_fault_plan_truncate_delay_and_custom_exc():
+    slept = []
+    with FaultPlan(
+            FaultSpec("a", action="truncate", fraction=0.25),
+            FaultSpec("b", action="delay", delay=3.5),
+            FaultSpec("c", exc=urllib.error.URLError("injected")),
+            sleep=slept.append) as plan:
+        assert fault_site("a", payload=b"01234567") == b"01"
+        assert fault_site("b", payload="kept") == "kept"
+        assert slept == [3.5]
+        with pytest.raises(urllib.error.URLError):
+            fault_site("c")
+    assert len(plan.triggered) == 3
+
+
+def test_fault_plans_do_not_nest():
+    with FaultPlan():
+        with pytest.raises(RuntimeError, match="already active"):
+            with FaultPlan():
+                pass
+    with FaultPlan():  # prior exit released the slot
+        pass
+
+
+def test_readers_fault_site_injects_per_file(tmp_path):
+    from mmlspark_tpu.io.readers import iter_binary_entries
+    for i in range(3):
+        (tmp_path / f"f{i}.bin").write_bytes(b"x" * 10)
+    with FaultPlan(FaultSpec("readers.read", on_hit=2, action="truncate",
+                             fraction=0.5)):
+        blobs = [b for _, b in iter_binary_entries(str(tmp_path))]
+    assert [len(b) for b in blobs] == [10, 5, 10]
+    with FaultPlan(FaultSpec("readers.read", on_hit=1, exc=OSError)):
+        with pytest.raises(OSError):
+            list(iter_binary_entries(str(tmp_path)))
+
+
+# -- crash-safe download -----------------------------------------------------
+
+@pytest.fixture
+def model_server(tmp_path):
+    """Local HTTP repo serving one published model; yields (base_url,
+    schema, cache_repo, params)."""
+    serve_dir = tmp_path / "served"
+    serve_dir.mkdir()
+    publish = LocalRepo(str(serve_dir))
+    params = {"w": np.arange(64, dtype=np.float32).reshape(8, 8),
+              "b": np.ones((8,), np.float32)}
+    schema = publish.save_model(
+        ModelSchema(name="tiny", architecture="mlp_tabular"), params)
+    publish.write_manifest()
+    handler = functools.partial(http.server.SimpleHTTPRequestHandler,
+                                directory=str(serve_dir))
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    cache_dir = tmp_path / "cache"
+    cache_dir.mkdir()
+    try:
+        yield (f"http://127.0.0.1:{server.server_address[1]}", schema,
+               LocalRepo(str(cache_dir)), params)
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def _repo(base, cache, **retry_kw):
+    retry_kw.setdefault("max_attempts", 3)
+    return HttpRepo(base, cache, timeout=5.0,
+                    retry=RetryPolicy(**retry_kw, **_NOSLEEP))
+
+
+def test_transient_http_error_retried_to_success(model_server):
+    base, schema, cache, _ = model_server
+    repo = _repo(base, cache)
+    with FaultPlan(FaultSpec("downloader.fetch", on_hit=1,
+                             exc=urllib.error.URLError("injected reset"))
+                   ) as plan:
+        listed = repo.list_schemas()
+    assert [s.name for s in listed] == ["tiny"]
+    assert plan.triggered == [("downloader.fetch", 1, "raise")]
+
+
+def test_truncated_download_never_cached_and_refetched(model_server):
+    base, schema, cache, _ = model_server
+    repo = _repo(base, cache)
+    cache_path = os.path.join(cache.root, "tiny.npz")
+    with FaultPlan(FaultSpec("downloader.payload", on_hit=1,
+                             action="truncate", fraction=0.5)) as plan:
+        path = repo.get_model_path(schema)
+    # the truncated first attempt failed sha256 and was retried — the file
+    # that landed in the cache is the full, verified payload
+    assert plan.triggered == [("downloader.payload", 1, "truncate")]
+    assert path == cache_path
+    assert sha256_file(cache_path) == schema.hash
+    # no temp litter from the failed attempt
+    assert [f for f in os.listdir(cache.root) if ".tmp." in f] == []
+
+
+def test_corrupt_cached_file_is_refetched(model_server):
+    base, schema, cache, params = model_server
+    repo = _repo(base, cache)
+    path = repo.get_model_path(schema)
+    with open(path, "wb") as f:
+        f.write(b"truncated garbage")  # the pre-hardening failure mode
+    # pre-hardening this poisoned the cache forever; now it re-downloads
+    assert repo.get_model_path(schema) == path
+    assert sha256_file(path) == schema.hash
+
+
+def test_truncation_every_attempt_exhausts_retries(model_server):
+    base, schema, cache, _ = model_server
+    repo = _repo(base, cache, max_attempts=2)
+    with FaultPlan(FaultSpec("downloader.payload", on_hit=1, times=99,
+                             action="truncate", fraction=0.5)):
+        with pytest.raises(IOError, match="sha256 mismatch"):
+            repo.get_model_path(schema)
+    assert not os.path.exists(os.path.join(cache.root, "tiny.npz"))
+
+
+# -- crash-safe checkpointing ------------------------------------------------
+
+DIM = 8
+
+
+def _make_trainer():
+    mesh = make_mesh(MeshSpec(data=4, tensor=2))
+
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return ((pred - batch["y"]) ** 2).mean()
+
+    return DistributedTrainer(loss_fn, optax.adam(1e-2), mesh=mesh)
+
+
+def _init_params():
+    return {"w": jnp.ones((DIM, DIM), jnp.float32) * 0.1,
+            "b": jnp.zeros((DIM,), jnp.float32)}
+
+
+def _batch(step):
+    rng = np.random.default_rng(step)
+    x = rng.normal(0, 1, (16, DIM)).astype(np.float32)
+    return {"x": x, "y": (x * 0.5).astype(np.float32)}
+
+
+def _loop(ckdir, save_every=2):
+    return ResilientTrainLoop(_make_trainer(), TrainCheckpointer(ckdir),
+                              _init_params, save_every=save_every)
+
+
+def _crash(loop, batch_fn, total_steps):
+    """Run a loop expecting an InjectedFault, then settle its checkpointer
+    (a saved-but-uncommitted async write either lands or is lost at process
+    death; close() resolves that nondeterminism for the in-process test)."""
+    with pytest.raises(InjectedFault):
+        loop.run(batch_fn, total_steps)
+    loop.ckpt.close()
+
+
+def _assert_bit_identical(a, b):
+    fa, ta = jax.tree_util.tree_flatten(jax.device_get(a))
+    fb, tb = jax.tree_util.tree_flatten(jax.device_get(b))
+    assert ta == tb
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_checkpointer_close_is_idempotent(tmp_path):
+    ckpt = TrainCheckpointer(str(tmp_path / "ck"))
+    ckpt.close()
+    ckpt.close()  # double close: no-op, no raise
+
+
+def test_checkpointer_close_after_failed_save(tmp_path):
+    trainer = _make_trainer()
+    state = trainer.init(_init_params)
+    ckpt = TrainCheckpointer(str(tmp_path / "ck"))
+    with FaultPlan(FaultSpec("checkpoint.save")):
+        with pytest.raises(InjectedFault):
+            ckpt.save(state, step=1, wait=True)
+    ckpt.close()  # failed save must not wedge close
+    ckpt.close()
+
+
+def test_quarantine_step_hides_it_from_the_manager(tmp_path):
+    trainer = _make_trainer()
+    state = trainer.init(_init_params)
+    ckpt = TrainCheckpointer(str(tmp_path / "ck"))
+    ckpt.save(state, step=1, wait=True)
+    ckpt.save(state, step=2, wait=True)
+    assert ckpt.all_steps() == [1, 2]
+    quarantined = ckpt.quarantine_step(2)
+    assert os.path.isdir(quarantined)  # preserved for forensics
+    assert ckpt.all_steps() == [1]
+    assert ckpt.latest_step() == 1
+    ckpt.close()
+
+
+def test_crash_mid_checkpoint_write_then_resume_is_bit_identical(tmp_path):
+    """ISSUE 1 acceptance: FaultPlan kills the run during a checkpoint
+    save; rerunning the same program resumes from the last committed step
+    and finishes with params bit-identical to an uninterrupted run."""
+    TOTAL = 6
+    ref = _loop(str(tmp_path / "ref")).run(_batch, TOTAL)
+
+    ckdir = str(tmp_path / "faulty")
+    # the 2nd checkpoint save (step 4 at save_every=2) dies mid-write
+    with FaultPlan(FaultSpec("checkpoint.save", on_hit=2)):
+        _crash(_loop(ckdir), _batch, TOTAL)
+    assert TrainCheckpointer(ckdir).latest_step() == 2  # step 4 never landed
+
+    resumed = _loop(ckdir).run(_batch, TOTAL)  # same program, rerun
+    assert TrainCheckpointer(ckdir).latest_step() == TOTAL
+    _assert_bit_identical(ref, resumed)
+
+
+def test_crash_mid_train_step_then_resume_is_bit_identical(tmp_path):
+    """Preemption between checkpoints (the trainer.train_step fault site):
+    resume loses at most save_every steps and still replays to bit parity."""
+    TOTAL = 6
+    ref = _loop(str(tmp_path / "ref")).run(_batch, TOTAL)
+
+    ckdir = str(tmp_path / "faulty")
+    with FaultPlan(FaultSpec("trainer.train_step", on_hit=5)):
+        _crash(_loop(ckdir), _batch, TOTAL)
+    assert TrainCheckpointer(ckdir).latest_step() == 4  # lost steps 5..6 only
+
+    resumed = _loop(ckdir).run(_batch, TOTAL)
+    _assert_bit_identical(ref, resumed)
+
+
+def test_corrupt_latest_checkpoint_falls_back_to_previous_step(tmp_path):
+    """ISSUE 1 acceptance: corrupt the newest checkpoint on disk;
+    ResilientTrainLoop quarantines it and resumes from the previous step
+    instead of crashing — and still reaches the bit-identical final state."""
+    TOTAL = 4
+    ref = _loop(str(tmp_path / "ref")).run(_batch, TOTAL)
+
+    ckdir = str(tmp_path / "victim")
+    loop = _loop(ckdir)
+    loop.run(_batch, TOTAL)  # checkpoints at steps 2 and 4
+    loop.ckpt.close()
+
+    step4 = os.path.join(ckdir, "4")
+    assert os.path.isdir(step4)
+    for root, _dirs, files in os.walk(step4):  # bitrot every payload file
+        for fn in files:
+            with open(os.path.join(root, fn), "wb") as f:
+                f.write(b"corrupt garbage")
+
+    fresh = _loop(ckdir)
+    state, start = fresh.restore_or_init()
+    assert start == 2  # fell back past the corrupt step 4
+    assert fresh.ckpt.all_steps() == [2]
+    assert any(name.startswith("corrupt-4")
+               for name in os.listdir(ckdir))  # quarantined, not deleted
+
+    resumed = fresh.run(_batch, TOTAL)  # replays 3..4 from step 2
+    _assert_bit_identical(ref, resumed)
+
+
+def test_resilient_loop_noop_when_already_complete(tmp_path):
+    ckdir = str(tmp_path / "ck")
+    final = _loop(ckdir).run(_batch, 4)
+    again = _loop(ckdir).run(_batch, 4)  # restore only, zero extra steps
+    _assert_bit_identical(final, again)

@@ -1,0 +1,120 @@
+"""The static reliability lint, enforced from inside the pytest lane
+(the ``tests/test_namecheck.py`` convention).
+
+Gate: no ``urlopen(`` without ``timeout=`` and no bare ``except:`` /
+``except Exception: pass`` anywhere in ``mmlspark_tpu/`` — the two bug
+shapes that shipped in the pre-reliability downloader (indefinite hang on a
+stalled connection) and that would silently defeat fault injection.
+"""
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+from mmlspark_tpu.reliability import lint
+
+REPO = Path(__file__).resolve().parent.parent
+TOOL = REPO / "tools" / "check_reliability.py"
+
+
+def test_repo_is_clean():
+    proc = subprocess.run(
+        [sys.executable, str(TOOL)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, \
+        f"reliability lint problems:\n{proc.stdout}{proc.stderr}"
+
+
+def test_missing_root_fails_loudly():
+    proc = subprocess.run(
+        [sys.executable, str(TOOL), "definitely_missing_dir"],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode != 0
+    assert "root not found" in proc.stdout
+
+
+def test_cli_check_subcommand_runs_the_same_lint(capsys):
+    from mmlspark_tpu.cli import main
+    assert main(["check", "mmlspark_tpu"]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def _problems(src: str) -> list:
+    return lint.check_source(textwrap.dedent(src), filename="mod.py")
+
+
+def test_flags_urlopen_without_timeout():
+    probs = _problems("""
+        import urllib.request
+
+        def fetch(url):
+            with urllib.request.urlopen(url) as r:
+                return r.read()
+    """)
+    assert len(probs) == 1 and "timeout" in probs[0]
+    assert "mod.py:5" in probs[0]
+
+
+def test_accepts_urlopen_with_timeout_kw_or_positional():
+    assert _problems("""
+        from urllib.request import urlopen
+
+        def fetch(url):
+            return urlopen(url, timeout=30).read()
+
+        def fetch2(url):
+            return urlopen(url, None, 30).read()
+
+        def fetch3(url, **kw):
+            return urlopen(url, **kw).read()
+    """) == []
+
+
+def test_flags_bare_except_and_swallowed_exception():
+    probs = _problems("""
+        def a():
+            try:
+                risky()
+            except:
+                handle()
+
+        def b():
+            try:
+                risky()
+            except Exception:
+                pass
+
+        def c():
+            try:
+                risky()
+            except (ValueError, BaseException):
+                pass
+    """)
+    assert len(probs) == 3
+    assert "bare `except:`" in probs[0]
+    assert "except Exception: pass" in probs[1]
+
+
+def test_accepts_narrow_or_handled_excepts():
+    assert _problems("""
+        def a():
+            try:
+                risky()
+            except ValueError:
+                pass  # narrow type: an explicit, greppable decision
+
+        def b():
+            try:
+                risky()
+            except Exception as e:
+                log(e)  # broad but HANDLED
+    """) == []
+
+
+def test_syntax_error_is_reported_not_crashing(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    probs = lint.check_file(bad)
+    assert len(probs) == 1 and "syntax error" in probs[0]

@@ -1,0 +1,24 @@
+#!/usr/bin/env python3
+"""Standalone runner for the static reliability lint.
+
+Thin wrapper over ``mmlspark_tpu.reliability.lint`` (single source of truth,
+the ``tools/namecheck.py`` convention): fails on any ``urlopen(`` call
+without a ``timeout=`` argument and any bare ``except:`` or ``except
+Exception: pass`` in ``mmlspark_tpu/``.
+
+Usage: ``python tools/check_reliability.py [root ...]`` — roots default to
+``mmlspark_tpu``. Also exposed as ``mmlspark-tpu check`` and enforced from
+the tier-1 lane by ``tests/test_reliability_lint.py``.
+
+Exit status: 0 = clean, 1 = problems found (including a missing root — bad
+invocation must fail loudly, not shrink coverage).
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from mmlspark_tpu.reliability import lint  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(lint.main(sys.argv[1:]))
