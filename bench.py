@@ -1238,6 +1238,20 @@ CONFIG_UNITS = {
 }
 
 
+def _emit_bench_event(name: str, result: dict) -> None:
+    """Write one per-config result through the telemetry event log, so a
+    bench run with ``observability.events_path`` set (or the env override
+    ``MMLSPARK_TPU_OBSERVABILITY_EVENTS_PATH``) lands in the same JSONL the
+    run report reads. A no-op when no events path is configured, and never
+    fatal — benchmark numbers must not die on telemetry I/O."""
+    try:
+        from mmlspark_tpu.observability import events
+        if events.events_enabled():
+            events.emit("event", "bench.config", config=name, result=result)
+    except Exception as e:
+        print(f"# bench event emit failed: {e}", file=sys.stderr)
+
+
 def _enable_compile_cache() -> None:
     """Persistent XLA compilation cache next to the repo: ViT-B/16 and
     ResNet-50 compiles take minutes through a remote-compile tunnel; the
@@ -1314,6 +1328,7 @@ def main() -> int:
             results[name]["config_wall_s"] = round(
                 time.perf_counter() - t_cfg, 1)
             print(f"# {name}: {results[name]}", file=sys.stderr)
+            _emit_bench_event(name, results[name])
     except (_Terminated, KeyboardInterrupt):
         # drivers often re-send TERM before escalating to KILL; a second
         # delivery must not blow away the epilogue that prints the line.

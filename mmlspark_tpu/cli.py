@@ -232,7 +232,7 @@ def cmd_launch_pod(args, passthrough: List[str]) -> int:
         _parse_mesh(args.mesh)  # fail fast before touching the cluster
     argv = build_pod_argv(args, passthrough)
     if args.dry_run:
-        print(json.dumps(argv))
+        print(json.dumps(argv))  # lint: allow-print (stdout IS the contract)
         return 0
     import subprocess
     return subprocess.call(argv)
@@ -247,17 +247,25 @@ def cmd_info(args, passthrough) -> int:
         info["backend"] = jax.default_backend()
     except Exception as e:  # pragma: no cover - backendless env
         info["backend_error"] = str(e)
-    print(json.dumps(info, indent=2, default=str))
+    print(json.dumps(info, indent=2, default=str))  # lint: allow-print
     return 0
 
 
 def cmd_check(args, passthrough) -> int:
     """Static reliability lint (urlopen-without-timeout, swallowed
-    excepts) over the installed package, or explicit roots."""
+    excepts, print-in-library-code) over the installed package, or
+    explicit roots."""
     from mmlspark_tpu.reliability import lint
     roots = args.roots or [os.path.dirname(
         os.path.abspath(__import__("mmlspark_tpu").__file__))]
     return lint.main(roots)
+
+
+def cmd_report(args, passthrough) -> int:
+    """Render a run report from a telemetry event log (JSONL)."""
+    from mmlspark_tpu.observability.report import render_report
+    print(render_report(args.events, top=args.top))  # lint: allow-print
+    return 0
 
 
 def cmd_bench(args, passthrough) -> int:
@@ -339,6 +347,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                          help="files/dirs to lint (default: the installed "
                          "mmlspark_tpu package)")
     check_p.set_defaults(fn=cmd_check)
+
+    report_p = sub.add_parser(
+        "report", help="render a run report from a telemetry event log")
+    report_p.add_argument("events", help="path to an events.jsonl written "
+                          "with observability.events_path set")
+    report_p.add_argument("--top", type=int, default=10,
+                          help="rows in the slowest-span table (default 10)")
+    report_p.set_defaults(fn=cmd_report)
 
     args = parser.parse_args(argv)
     return args.fn(args, passthrough)

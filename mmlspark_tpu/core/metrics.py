@@ -23,10 +23,19 @@ class MetricValue:
     model_uid: str = ""
 
     def log(self, logger=None) -> None:
+        """Log AND forward through the telemetry layer: the value lands in
+        the metrics registry (gauge ``metrics.<name>``) and, when the event
+        log is on, as a ``metric`` event — one observability pipeline for
+        evaluator metrics and train-loop metrics alike."""
+        from mmlspark_tpu.observability import events, metrics as obsmetrics
         from mmlspark_tpu.utils.logging import get_logger
         (logger or get_logger("metrics")).info(
             "metric %s=%.6g%s", self.name, self.value,
             f" model={self.model_uid}" if self.model_uid else "")
+        obsmetrics.gauge(f"metrics.{self.name}").set(self.value)
+        if events.events_enabled():
+            events.emit("metric", self.name, value=self.value,
+                        model=self.model_uid)
 
 
 @dataclass(frozen=True)
@@ -43,12 +52,16 @@ class MetricTable:
             {c: arr[:, i] for i, c in enumerate(self.columns)})
 
     def log(self, logger=None) -> None:
+        from mmlspark_tpu.observability import events
         from mmlspark_tpu.utils.logging import get_logger
         log = logger or get_logger("metrics")
         arr = np.asarray(self.rows)
         log.info("metric table %s (%d rows x %s)%s", self.name, len(arr),
                  list(self.columns),
                  f" model={self.model_uid}" if self.model_uid else "")
+        if events.events_enabled():
+            events.emit("metric", self.name, rows=int(len(arr)),
+                        columns=list(self.columns), model=self.model_uid)
 
 
 def create(name: str, value: float, model_uid: str = "") -> MetricValue:

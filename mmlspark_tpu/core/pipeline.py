@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional, Sequence
 from mmlspark_tpu.core.frame import Frame
 from mmlspark_tpu.core.params import AnyParam, Params
 from mmlspark_tpu.core.schema import Schema
+from mmlspark_tpu.observability.spans import span
 
 
 class PipelineStage(Params):
@@ -99,11 +100,20 @@ class Pipeline(Estimator):
                        default=-1)
         fitted: List[Transformer] = []
         cur = frame
-        for i, stage in enumerate(stages):
-            model = stage.fit(cur) if isinstance(stage, Estimator) else stage
-            if i < last_est:
-                cur = model.transform(cur)
-            fitted.append(model)
+        # per-stage telemetry spans (no-ops unless observability.* is on);
+        # the outer span parents them so the event log nests fit:Pipeline ->
+        # fit:<Stage> -> transform:<Stage>
+        with span("fit", type(self).__name__):
+            for i, stage in enumerate(stages):
+                if isinstance(stage, Estimator):
+                    with span("fit", type(stage).__name__, stage=i):
+                        model = stage.fit(cur)
+                else:
+                    model = stage
+                if i < last_est:
+                    with span("transform", type(model).__name__, stage=i):
+                        cur = model.transform(cur)
+                fitted.append(model)
         return PipelineModel(stages=fitted)
 
 
@@ -111,8 +121,10 @@ class PipelineModel(Model):
     stages = AnyParam("stages", "ordered list of fitted transformers", default=[])
 
     def transform(self, frame: Frame) -> Frame:
-        for stage in self.get("stages"):
-            frame = stage.transform(frame)
+        with span("transform", type(self).__name__):
+            for stage in self.get("stages"):
+                with span("transform", type(stage).__name__):
+                    frame = stage.transform(frame)
         return frame
 
     def transform_schema(self, schema: Schema) -> Schema:

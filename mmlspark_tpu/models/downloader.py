@@ -24,6 +24,8 @@ from typing import Any, Dict, Iterable, List, Optional, Union
 
 import numpy as np
 
+from mmlspark_tpu.observability import events as obsevents
+from mmlspark_tpu.observability import metrics as obsmetrics
 from mmlspark_tpu.reliability.faults import fault_site
 from mmlspark_tpu.reliability.retry import RetryPolicy
 
@@ -195,12 +197,22 @@ class HttpRepo(Repository):
         path = os.path.join(self.cache.root, f"{schema.name}.npz")
         cached_ok = os.path.exists(path) and (
             not schema.hash or sha256_file(path) == schema.hash)
-        if not cached_ok:
+        # cache telemetry: counters are cold-path (a download dwarfs an int
+        # add), so they are unconditional; events stay behind the path gate
+        if cached_ok:
+            obsmetrics.counter("downloader.cache_hits").inc()
+        else:
+            obsmetrics.counter("downloader.cache_misses").inc()
             url = schema.uri or f"{self.base_url}/{schema.name}.npz"
             self.retry.call(self._download, url, schema, path)
             with open(os.path.join(self.cache.root,
                                    f"{schema.name}.meta"), "w") as f:
                 f.write(schema.to_json())
+            obsmetrics.counter("downloader.downloads").inc()
+            if obsevents.events_enabled():
+                obsevents.emit("event", "downloader.download",
+                               model=schema.name, url=url,
+                               bytes=os.path.getsize(path))
         return self.cache.get_model_path(schema)
 
 

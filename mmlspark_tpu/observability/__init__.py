@@ -1,0 +1,39 @@
+"""Unified telemetry: spans, event log, metrics registry, run reports.
+
+The single layer the whole stack reports through (SURVEY.md §5 sets the
+observability bar above the reference, which had nothing beyond test
+wall-clock timing). Three pieces, one pipeline:
+
+- :mod:`events` — a process-wide JSON-lines event log with an injectable
+  clock (tests are deterministic) — off until ``observability.events_path``
+  is set (env: ``MMLSPARK_TPU_OBSERVABILITY_EVENTS_PATH``);
+- :mod:`spans` — ``span("fit", "Featurize")`` context manager with a
+  context-propagated parent stack; each span emits one structured event on
+  exit and can pass through a ``jax.profiler.TraceAnnotation``
+  (``observability.annotate``);
+- :mod:`metrics` — counters / gauges / fixed-bucket histograms with
+  Prometheus text exposition and a JSON dump.
+
+Everything is off by default and near-zero-cost when disabled: ``span()``
+short-circuits to a shared no-op before any string work, ``emit()`` returns
+before serializing, and hot loops gate per-step collection on
+``observability.metrics``. ``mmlspark-tpu report <events.jsonl>``
+(:mod:`report`) renders the wall-time breakdown from a captured log.
+"""
+from mmlspark_tpu.observability.events import (  # noqa: F401
+    emit,
+    events_enabled,
+    perf,
+    reset_clock,
+    set_clock,
+    wall,
+)
+from mmlspark_tpu.observability.metrics import (  # noqa: F401
+    MetricsRegistry,
+    counter,
+    gauge,
+    get_registry,
+    histogram,
+    metrics_enabled,
+)
+from mmlspark_tpu.observability.spans import span  # noqa: F401

@@ -1,0 +1,107 @@
+"""Process-wide JSON-lines event log with an injectable clock.
+
+One append-only stream every subsystem reports through: spans
+(:mod:`spans`), train-loop metrics (``utils/logging.MetricLogger``),
+reliability activity (retries, fault hits, checkpoint quarantines), model
+downloads, and bench results. Each line is one JSON object::
+
+    {"ts": <wall seconds>, "type": "span"|"event"|"metric", "name": "...",
+     ...event-specific fields...}
+
+Off until ``observability.events_path`` is set (config or
+``MMLSPARK_TPU_OBSERVABILITY_EVENTS_PATH``); :func:`emit` then appends and
+flushes under a lock, so concurrent threads interleave whole lines, never
+partial ones. The clock pair (:func:`wall` for timestamps, :func:`perf`
+for durations) is injectable via :func:`set_clock` so tests produce
+byte-deterministic logs. Multi-process runs should point each process at
+its own path (e.g. suffix ``jax.process_index()``) — appends from separate
+processes are not coordinated.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from mmlspark_tpu.utils import config
+
+_lock = threading.Lock()
+# injectable clock: [wall, perf] — swapped atomically under _lock
+_clock = [time.time, time.perf_counter]
+# lazily-opened writer, re-resolved when the configured path changes
+_writer_path: Optional[str] = None
+_writer_fh = None
+
+
+def wall() -> float:
+    """Wall-clock seconds (event timestamps)."""
+    return _clock[0]()
+
+
+def perf() -> float:
+    """Monotonic seconds (durations)."""
+    return _clock[1]()
+
+
+def set_clock(wall_fn: Optional[Callable[[], float]] = None,
+              perf_fn: Optional[Callable[[], float]] = None) -> None:
+    """Inject fake clocks (tests). ``None`` leaves that clock unchanged."""
+    with _lock:
+        if wall_fn is not None:
+            _clock[0] = wall_fn
+        if perf_fn is not None:
+            _clock[1] = perf_fn
+
+
+def reset_clock() -> None:
+    with _lock:
+        _clock[0] = time.time
+        _clock[1] = time.perf_counter
+
+
+def events_enabled() -> bool:
+    """Is the event log on? The one check hot paths make before any
+    event-related work (string building, dict assembly)."""
+    return bool(config.get("observability.events_path"))
+
+
+def events_path() -> str:
+    return config.get("observability.events_path")
+
+
+def emit(etype: str, name: str, **fields: Any) -> None:
+    """Append one event line; a silent no-op when the log is off.
+
+    ``fields`` must be JSON-representable; anything else falls back to
+    ``str()`` rather than killing the instrumented caller.
+    """
+    path = config.get("observability.events_path")
+    if not path:
+        return
+    event = {"ts": round(wall(), 6), "type": etype, "name": name}
+    event.update(fields)
+    line = json.dumps(event, sort_keys=True, default=str)
+    global _writer_path, _writer_fh
+    with _lock:
+        if _writer_path != path:
+            if _writer_fh is not None:
+                _writer_fh.close()
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+            _writer_fh = open(path, "a", encoding="utf-8")
+            _writer_path = path
+        _writer_fh.write(line + "\n")
+        _writer_fh.flush()
+
+
+def close() -> None:
+    """Close the writer (tests / clean shutdown); the next :func:`emit`
+    reopens in append mode, so nothing is lost."""
+    global _writer_path, _writer_fh
+    with _lock:
+        if _writer_fh is not None:
+            _writer_fh.close()
+        _writer_fh = None
+        _writer_path = None

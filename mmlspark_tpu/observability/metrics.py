@@ -1,0 +1,231 @@
+"""Metrics registry: counters, gauges, fixed-bucket histograms.
+
+The process-wide accumulation half of the telemetry layer: cold paths
+(downloads, retries, checkpoint saves, quarantines) count unconditionally —
+an int add under a lock — while hot per-step paths gate on
+:func:`metrics_enabled` (``observability.metrics``) so a disabled run pays
+nothing per step. Two export formats:
+
+- :meth:`MetricsRegistry.prometheus_text` — the Prometheus text exposition
+  (``# TYPE`` headers, ``_bucket{le=...}`` / ``_sum`` / ``_count`` for
+  histograms), names sanitized to the Prometheus charset;
+- :meth:`MetricsRegistry.to_dict` / :meth:`to_json` — a JSON dump for the
+  event log or ad-hoc inspection.
+
+Histogram buckets are FIXED at creation (cumulative ``le`` semantics, a
+``+Inf`` slot implied) — no dynamic resizing, so ``observe`` is O(buckets)
+with no allocation.
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import re
+import threading
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from mmlspark_tpu.utils import config
+
+# Prometheus histogram defaults, widened to cover sub-ms XLA steps through
+# multi-second compile-bound ones.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0)
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def metrics_enabled() -> bool:
+    """Gate for HOT-path collection (per-step histograms/gauges). Cold-path
+    counters do not consult this — they are a lock + int add."""
+    return bool(config.get("observability.metrics"))
+
+
+def sanitize(name: str) -> str:
+    """Dotted registry name -> Prometheus-charset metric name."""
+    name = _NAME_RE.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+class Counter:
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name}: inc({n}) must be >= 0")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Cumulative fixed-bucket histogram (Prometheus ``le`` semantics)."""
+
+    __slots__ = ("name", "buckets", "_lock", "_counts", "_sum", "_count")
+
+    def __init__(self, name: str, buckets: Sequence[float] = DEFAULT_BUCKETS):
+        if not buckets or list(buckets) != sorted(buckets):
+            raise ValueError(
+                f"histogram {name}: buckets must be non-empty and ascending")
+        self.name = name
+        self.buckets = tuple(float(b) for b in buckets)
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf overflow
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def cumulative(self) -> Dict[str, int]:
+        """``{le: cumulative count}`` including the ``+Inf`` bucket."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            running = 0
+            for b, c in zip(self.buckets, self._counts):
+                running += c
+                out[repr(b)] = running
+            out["+Inf"] = running + self._counts[-1]
+        return out
+
+
+class MetricsRegistry:
+    """Typed name -> instrument map; instruments are created on first use
+    and re-registration with a different type is an error (a counter named
+    like an existing gauge is a bug, not a new metric)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Any] = {}
+
+    def _get(self, name: str, cls, *args):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, *args)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str,
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get(name, Histogram, buckets or DEFAULT_BUCKETS)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    def to_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            items = sorted(self._metrics.items())
+        out: Dict[str, Any] = {}
+        for name, m in items:
+            if isinstance(m, Counter):
+                out[name] = {"type": "counter", "value": m.value}
+            elif isinstance(m, Gauge):
+                out[name] = {"type": "gauge", "value": m.value}
+            else:
+                out[name] = {"type": "histogram", "count": m.count,
+                             "sum": m.sum, "buckets": m.cumulative()}
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    def prometheus_text(self) -> str:
+        """Prometheus text-format exposition of every registered metric."""
+        lines = []
+        with self._lock:
+            items = sorted(self._metrics.items())
+        for name, m in items:
+            pname = sanitize(name)
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {pname} counter")
+                lines.append(f"{pname} {_fmt(m.value)}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {pname} gauge")
+                lines.append(f"{pname} {_fmt(m.value)}")
+            else:
+                lines.append(f"# TYPE {pname} histogram")
+                for le, c in m.cumulative().items():
+                    lines.append(f'{pname}_bucket{{le="{le}"}} {c}')
+                lines.append(f"{pname}_sum {_fmt(m.sum)}")
+                lines.append(f"{pname}_count {m.count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _fmt(v: float) -> str:
+    return repr(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default registry all instrumentation reports to."""
+    return _REGISTRY
+
+
+def counter(name: str) -> Counter:
+    return _REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _REGISTRY.gauge(name)
+
+
+def histogram(name: str,
+              buckets: Optional[Sequence[float]] = None) -> Histogram:
+    return _REGISTRY.histogram(name, buckets)

@@ -1,0 +1,157 @@
+"""Run reports: render a captured events.jsonl into a human summary.
+
+The offline half of the telemetry loop (``mmlspark-tpu report
+<events.jsonl>``): given the JSON-lines log a run produced under
+``observability.events_path``, print where the time went —
+
+- per-stage wall-time breakdown: spans aggregated by name (count, total,
+  mean, share of the root spans' wall time);
+- slowest individual spans (the long-tail view the aggregate hides);
+- reliability activity: retry attempts, fault-site hits, checkpoint
+  quarantines, by site;
+- throughput: the ``train.fit`` / ``train.step`` summaries the trainer and
+  MetricLogger emit (steps, rows, examples/sec), plus any bench results.
+
+Pure text in, text out — no jax, no framework state — so it runs anywhere
+the log file can be copied to.
+"""
+from __future__ import annotations
+
+import json
+from collections import defaultdict
+from typing import Any, Dict, List
+
+from mmlspark_tpu.utils.logging import get_logger
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSON-lines event log; malformed lines are counted and
+    skipped (a crash mid-write may truncate the final line), not fatal."""
+    events: List[Dict[str, Any]] = []
+    bad = 0
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                bad += 1
+    if bad:
+        get_logger("observability.report").warning(
+            "%s: skipped %d malformed line(s)", path, bad)
+    return events
+
+
+def _table(rows: List[List[str]], header: List[str]) -> List[str]:
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*header), fmt.format(*("-" * w for w in widths))]
+    lines.extend(fmt.format(*map(str, r)) for r in rows)
+    return lines
+
+
+def render_report(path: str, top: int = 10) -> str:
+    """The full text report for one event log."""
+    events = load_events(path)
+    spans = [e for e in events if e.get("type") == "span"]
+    plain = [e for e in events if e.get("type") == "event"]
+    metrics = [e for e in events if e.get("type") == "metric"]
+
+    out: List[str] = [f"run report: {path}",
+                      f"{len(events)} events "
+                      f"({len(spans)} spans, {len(metrics)} metrics)", ""]
+
+    # -- per-stage wall time -------------------------------------------------
+    if spans:
+        agg: Dict[str, List[float]] = defaultdict(list)
+        for s in spans:
+            agg[s.get("name", "?")].append(float(s.get("dur_s", 0.0)))
+        # run wall = sum of root spans; fall back to the span total when the
+        # log has no roots (e.g. a filtered or partial capture)
+        root_total = sum(float(s.get("dur_s", 0.0)) for s in spans
+                         if not s.get("parent_id"))
+        denom = root_total or sum(sum(v) for v in agg.values()) or 1.0
+        rows = []
+        for name, durs in sorted(agg.items(),
+                                 key=lambda kv: -sum(kv[1]))[:top]:
+            total = sum(durs)
+            rows.append([name, len(durs), f"{total:.4f}",
+                         f"{total / len(durs) * 1e3:.2f}",
+                         f"{100.0 * total / denom:.1f}%"])
+        out.append("per-stage wall time:")
+        out.extend(_table(rows, ["span", "count", "total_s", "mean_ms",
+                                 "share"]))
+        out.append("")
+
+        slow = sorted(spans, key=lambda s: -float(s.get("dur_s", 0.0)))[:top]
+        rows = [[s.get("name", "?"), f"{float(s.get('dur_s', 0.0)):.4f}",
+                 s.get("depth", 0), s.get("parent", "") or "-"]
+                for s in slow]
+        out.append("slowest spans:")
+        out.extend(_table(rows, ["span", "dur_s", "depth", "parent"]))
+        out.append("")
+
+    # -- reliability ---------------------------------------------------------
+    retries = [e for e in plain if e.get("name") == "retry.attempt"]
+    faults = [e for e in plain if e.get("name") == "fault.hit"]
+    quarantines = [e for e in plain
+                   if e.get("name") == "checkpoint.quarantine"]
+    if retries or faults or quarantines:
+        out.append("reliability:")
+        if retries:
+            by_site: Dict[str, int] = defaultdict(int)
+            for e in retries:
+                by_site[e.get("policy", "?")] += 1
+            detail = ", ".join(f"{k}={v}" for k, v in sorted(by_site.items()))
+            out.append(f"  retry attempts: {len(retries)} ({detail})")
+        if faults:
+            by_site = defaultdict(int)
+            for e in faults:
+                by_site[e.get("site", "?")] += 1
+            detail = ", ".join(f"{k}={v}" for k, v in sorted(by_site.items()))
+            out.append(f"  fault hits: {len(faults)} ({detail})")
+        if quarantines:
+            steps = [e.get("step") for e in quarantines]
+            out.append(f"  checkpoint quarantines: {len(quarantines)} "
+                       f"(steps {steps})")
+        out.append("")
+
+    # -- throughput ----------------------------------------------------------
+    fits = [e for e in plain if e.get("name") == "train.fit"]
+    step_metrics = [e for e in metrics if e.get("name") == "train.step"]
+    if fits or step_metrics:
+        out.append("throughput:")
+        for e in fits:
+            out.append(
+                f"  train.fit: {e.get('steps', '?')} steps, "
+                f"{e.get('rows', '?')} rows in {e.get('wall_s', 0):.3f}s "
+                f"({e.get('examples_per_sec', 0):.1f} examples/sec)")
+        if step_metrics:
+            last = step_metrics[-1]
+            rates = [m.get("examples_per_sec", 0.0) for m in step_metrics]
+            out.append(
+                f"  train.step: {len(step_metrics)} logged steps, last "
+                f"step {last.get('step', '?')}, examples/sec last="
+                f"{rates[-1]:.1f} max={max(rates):.1f}")
+        out.append("")
+
+    # -- bench results -------------------------------------------------------
+    bench = [e for e in plain if e.get("name") == "bench.config"]
+    if bench:
+        rows = []
+        for e in bench:
+            r = e.get("result") or {}
+            rows.append([e.get("config", "?"),
+                         r.get("value", "-"), r.get("unit", "-"),
+                         r.get("vs_baseline", "-")])
+        out.append("bench configs:")
+        out.extend(_table(rows, ["config", "value", "unit", "vs_baseline"]))
+        out.append("")
+
+    if len(out) == 3:  # only the header: nothing recognizable in the log
+        out.append("no spans, reliability events, or throughput records "
+                   "found")
+    return "\n".join(out).rstrip() + "\n"

@@ -1,0 +1,115 @@
+"""Wall-time spans with a context-propagated parent stack.
+
+``span(kind, detail)`` times a region and emits one ``"span"`` event to the
+event log on exit, carrying its ``span_id``, its parent's id/name, and its
+depth — enough to reconstruct the full nesting tree offline
+(``mmlspark-tpu report``). The stack lives in a ``contextvars.ContextVar``,
+so threads and async tasks each see their own ancestry instead of racing a
+global.
+
+Cost discipline: when neither ``observability.events_path`` nor
+``observability.annotate`` is set, :func:`span` returns a shared no-op
+context manager BEFORE any string is built — the name is assembled from
+``(kind, detail)`` only on the enabled path, which is why call sites pass
+the two pieces instead of a preformatted f-string. With
+``observability.annotate`` on, the span also opens a
+``jax.profiler.TraceAnnotation`` so the same names line up in
+TensorBoard/Perfetto timelines (via the failure-safe
+``utils.profiling.annotate``).
+"""
+from __future__ import annotations
+
+import contextvars
+import itertools
+import threading
+from typing import Any, Optional, Tuple
+
+from mmlspark_tpu.observability import events
+from mmlspark_tpu.utils import config
+
+# (name, span_id) ancestry for the current context; () at the root
+_STACK: contextvars.ContextVar[Tuple[Tuple[str, int], ...]] = \
+    contextvars.ContextVar("mmlspark_tpu_span_stack", default=())
+_ids = itertools.count(1)
+_ids_lock = threading.Lock()
+
+
+class _NoopSpan:
+    """Shared disabled-path singleton: zero allocation per use."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "span_id", "_token", "_start_wall",
+                 "_start_perf", "_parent", "_depth", "_annotation")
+
+    def __init__(self, name: str, attrs: dict, annotate: bool):
+        self.name = name
+        self.attrs = attrs
+        with _ids_lock:
+            self.span_id = next(_ids)
+        self._annotation = None
+        if annotate:
+            from mmlspark_tpu.utils.profiling import annotate as _annotate
+            self._annotation = _annotate(name)
+
+    def __enter__(self) -> "_Span":
+        stack = _STACK.get()
+        self._parent = stack[-1] if stack else None
+        self._depth = len(stack)
+        self._token = _STACK.set(stack + ((self.name, self.span_id),))
+        self._start_wall = events.wall()
+        self._start_perf = events.perf()
+        if self._annotation is not None:
+            self._annotation.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._annotation is not None:
+            self._annotation.__exit__(exc_type, exc, tb)
+        dur = events.perf() - self._start_perf
+        _STACK.reset(self._token)
+        fields = {
+            "span_id": self.span_id,
+            "parent_id": self._parent[1] if self._parent else None,
+            "parent": self._parent[0] if self._parent else "",
+            "depth": self._depth,
+            "start": round(self._start_wall, 6),
+            "dur_s": round(dur, 9),
+        }
+        if exc_type is not None:
+            fields["error"] = exc_type.__name__
+        if self.attrs:
+            fields["attrs"] = self.attrs
+        events.emit("span", self.name, **fields)
+        return False
+
+
+def span(kind: str, detail: str = "", **attrs: Any):
+    """Context manager timing ``kind[:detail]`` (e.g. ``span("fit",
+    "Featurize")`` -> span name ``fit:Featurize``).
+
+    Returns the shared no-op when telemetry is off — callers may hold the
+    result but must not rely on span identity. ``attrs`` ride along on the
+    emitted event (keep them small and JSON-friendly).
+    """
+    annotate = bool(config.get("observability.annotate"))
+    if not (annotate or events.events_enabled()):
+        return _NOOP
+    return _Span(f"{kind}:{detail}" if detail else kind, attrs, annotate)
+
+
+def current_span() -> Optional[Tuple[str, int]]:
+    """(name, span_id) of the innermost open span, or None at the root."""
+    stack = _STACK.get()
+    return stack[-1] if stack else None

@@ -29,6 +29,9 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 
+from mmlspark_tpu.observability import events as obsevents
+from mmlspark_tpu.observability import metrics as obsmetrics
+from mmlspark_tpu.observability.spans import span
 from mmlspark_tpu.reliability.faults import fault_site
 from mmlspark_tpu.utils.logging import get_logger
 
@@ -68,11 +71,13 @@ class TrainCheckpointer:
             _LOG.warning("save(%d): removing stale step dir %s", step, stale)
             shutil.rmtree(stale)
             self.reload()
-        fault_site("checkpoint.save")
-        self._mgr.save(step, args=self._ocp.args.StandardSave(state))
-        fault_site("checkpoint.save.commit")
-        if wait:
-            self._mgr.wait_until_finished()
+        with span("checkpoint", "save", step=step):
+            fault_site("checkpoint.save")
+            self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+            fault_site("checkpoint.save.commit")
+            if wait:
+                self._mgr.wait_until_finished()
+        obsmetrics.counter("checkpoint.saves").inc()
         return step
 
     def wait(self) -> None:
@@ -131,14 +136,17 @@ class TrainCheckpointer:
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.directory}")
-        fault_site("checkpoint.restore")
-        abstract, shardings = trainer.abstract_state(init_params_fn)
-        target = jax.tree_util.tree_map(
-            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
-            abstract, shardings)
-        with trainer.mesh:
-            return self._mgr.restore(
-                step, args=self._ocp.args.StandardRestore(target))
+        with span("checkpoint", "restore", step=step):
+            fault_site("checkpoint.restore")
+            abstract, shardings = trainer.abstract_state(init_params_fn)
+            target = jax.tree_util.tree_map(
+                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+                abstract, shardings)
+            with trainer.mesh:
+                restored = self._mgr.restore(
+                    step, args=self._ocp.args.StandardRestore(target))
+        obsmetrics.counter("checkpoint.restores").inc()
+        return restored
 
     def restore_or_init(self, trainer, init_params_fn: Callable[[], Any]
                         ) -> Tuple[Any, bool]:
@@ -168,6 +176,10 @@ class TrainCheckpointer:
         else:
             _LOG.warning("quarantine_step(%d): %s does not exist", step, src)
         self.reload()
+        obsmetrics.counter("checkpoint.quarantines").inc()
+        if obsevents.events_enabled():
+            obsevents.emit("event", "checkpoint.quarantine", step=step,
+                           path=dst)
         return dst
 
     def reload(self) -> None:

@@ -28,13 +28,15 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mmlspark_tpu.parallel.mesh import mesh_from_config
+from mmlspark_tpu.observability import events as obsevents
+from mmlspark_tpu.observability import metrics as obsmetrics
 from mmlspark_tpu.reliability.faults import fault_site
 from mmlspark_tpu.parallel.sharding import (
     active_batch_axes, batch_sharding, is_cpu_mesh, local_batch_rows,
     mesh_spans_processes, param_shardings, Rules, shard_batch,
 )
 from mmlspark_tpu.utils import config as mmlconfig
-from mmlspark_tpu.utils.logging import MetricLogger
+from mmlspark_tpu.utils.logging import MetricLogger, get_logger
 
 LossFn = Callable[[Any, Dict[str, jax.Array], jax.Array], jax.Array]
 
@@ -334,6 +336,7 @@ class DistributedTrainer:
         # trip per step on remote chips.
         self._inflight: list = []
         self._throttled = is_cpu_mesh(self.mesh)
+        self._flops_per_step: Optional[float] = None  # lazy cost analysis
 
     # -- state -------------------------------------------------------------
     def _full_init_fn(self, init_params_fn: Callable[[], Any]):
@@ -450,6 +453,52 @@ class DistributedTrainer:
         with self.mesh:
             return self._eval_step(state["params"], batch, rng)
 
+    # -- telemetry ---------------------------------------------------------
+    def _estimate_flops(self, state, batch, rng) -> float:
+        """FLOPs of one compiled train step via XLA cost analysis.
+
+        Reuses the already-jitted step (lower+compile hits the jit cache, so
+        no second compile) and runs at most once per trainer — the result is
+        memoized in ``_flops_per_step``. Returns 0.0 when the backend offers
+        no cost model; the MFU gauges are simply skipped then.
+        """
+        try:
+            with self.mesh:
+                cost = (self._train_step.lower(state, batch, rng)
+                        .compile().cost_analysis())
+            if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+                cost = cost[0] if cost else {}
+            return float(cost.get("flops", 0.0)) if cost else 0.0
+        except Exception as e:
+            get_logger("parallel.trainer").debug(
+                "step cost analysis unavailable (%s: %s)",
+                type(e).__name__, e)
+            return 0.0
+
+    def _finish_epoch_telemetry(self, steps: int, rows: int,
+                                wall_s: float) -> None:
+        """End-of-epoch gauges + ``train.fit`` event (throughput, MFU)."""
+        eps = rows / max(wall_s, 1e-9)
+        obsmetrics.gauge("trainer.examples_per_sec").set(eps)
+        mfu = None
+        if self._flops_per_step:
+            achieved = (self._flops_per_step * steps
+                        / max(wall_s, 1e-9) / 1e12)
+            obsmetrics.gauge("trainer.achieved_tflops").set(achieved)
+            # MFU only means something against a real accelerator peak;
+            # on the CPU mesh the v5e denominator would be noise
+            if not is_cpu_mesh(self.mesh):
+                peak = float(mmlconfig.get("observability.peak_tflops"))
+                if peak > 0:
+                    mfu = achieved / peak
+                    obsmetrics.gauge("trainer.mfu").set(mfu)
+        if obsevents.events_enabled():
+            fields = dict(steps=steps, rows=rows, wall_s=round(wall_s, 6),
+                          examples_per_sec=round(eps, 3))
+            if mfu is not None:
+                fields["mfu"] = round(mfu, 4)
+            obsevents.emit("event", "train.fit", **fields)
+
     # -- data --------------------------------------------------------------
     def put_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
         with self.mesh:
@@ -477,11 +526,33 @@ class DistributedTrainer:
         losses = []
         metric_log = (MetricLogger(every=log_every)
                       if log_every and log_fn is None else None)
+        # telemetry is decided ONCE per fit, outside the step loop — with
+        # observability.* unset the loop body pays a single falsy check per
+        # step (no clock read, no histogram, no device sync)
+        telemetry = obsmetrics.metrics_enabled() or obsevents.events_enabled()
+        steps = rows_total = 0
+        if telemetry:
+            step_hist = obsmetrics.histogram("trainer.step_time_seconds")
+            t_start = t_prev = obsevents.perf()
         prefetcher = DevicePrefetcher(batches, self.put_batch, depth=prefetch)
         try:
             for i, batch in enumerate(prefetcher):
                 state, metrics = self.train_step(state, batch, rng)
                 losses.append(metrics["loss"])  # device scalar: no per-step sync
+                if telemetry:
+                    # dispatch-to-dispatch wall time: non-blocking (the loss
+                    # stays a device scalar; JAX dispatch is async, so this
+                    # tracks the pipeline's sustained rate, not device
+                    # latency of one step)
+                    now = obsevents.perf()
+                    step_hist.observe(now - t_prev)
+                    t_prev = now
+                    steps += 1
+                    rows_total += (next(iter(batch.values())).shape[0]
+                                   if batch else 0)
+                    if self._flops_per_step is None:
+                        self._flops_per_step = self._estimate_flops(
+                            state, batch, rng)
                 if log_fn is not None and log_every and i % log_every == 0:
                     log_fn(i, float(losses[-1]))
                 elif metric_log is not None:  # cadence handled inside (no
@@ -490,6 +561,13 @@ class DistributedTrainer:
                                batch_rows=rows)
         finally:
             prefetcher.close()  # stops the producer if we exited early
+        if telemetry and steps:
+            # one sync per EPOCH (the exit paths below all wait on the last
+            # loss anyway) so throughput covers completed device work, not
+            # just async dispatch
+            jax.block_until_ready(losses[-1])
+            self._finish_epoch_telemetry(steps, rows_total,
+                                         obsevents.perf() - t_start)
         if not losses:
             return state, []
         if not collect_losses:

@@ -135,6 +135,13 @@ class FaultPlan:
                 continue
             self.triggered.append((name, n, spec.action))
             _LOG.info("fault %r fired at %s (hit %d)", spec.action, name, n)
+            # a triggered fault is rare by construction: safe to count/emit
+            from mmlspark_tpu.observability import (events,
+                                                    metrics as obsmetrics)
+            obsmetrics.counter("reliability.fault_hits").inc()
+            if events.events_enabled():
+                events.emit("event", "fault.hit", site=name, hit=n,
+                            action=spec.action)
             if spec.action == "delay":
                 self._sleep(spec.delay)
             elif spec.action == "truncate":

@@ -9,6 +9,13 @@ BaseException: pass``: a swallowed error turns a crash into silent
 corruption — the failure mode the fault-injection harness exists to make
 reproducible, and the one a reliability subsystem must not ship.
 
+Rule 3 — ``print(...)`` in library code: stdout bypasses the framework
+logger tree AND the telemetry layer (observability/), so the output is
+invisible to log levels, event logs, and run reports. Route through
+``get_logger`` or ``observability.events.emit``. CLI entry points whose
+CONTRACT is stdout (e.g. ``mmlspark-tpu info`` printing JSON) mark the
+line with ``# lint: allow-print``.
+
 Shared core for ``tools/check_reliability.py`` (standalone CLI),
 ``mmlspark-tpu check`` (installed CLI), and the in-pytest gate
 (tests/test_reliability_lint.py) — same single source of truth pattern as
@@ -39,12 +46,30 @@ def _catches_everything(node: ast.expr) -> bool:
                and n.id in ("Exception", "BaseException") for n in names)
 
 
+_ALLOW_PRINT = "# lint: allow-print"
+
+
 def check_source(src: str, filename: str = "<src>") -> List[str]:
     """Return ``"file:line: message"`` problems for one module's source."""
     problems: List[str] = []
     tree = ast.parse(src, filename=filename)
+    lines = src.splitlines()
+
+    def _allowed(lineno: int) -> bool:
+        # marker anywhere on the offending line opts that line out
+        return (0 < lineno <= len(lines)
+                and _ALLOW_PRINT in lines[lineno - 1])
+
     for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and _is_urlopen(node):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+                and not _allowed(node.lineno)):
+            problems.append(
+                f"{filename}:{node.lineno}: print() in library code "
+                "(route through get_logger or the event log; stdout CLI "
+                f"contracts mark the line `{_ALLOW_PRINT}`)")
+        elif isinstance(node, ast.Call) and _is_urlopen(node):
             has_timeout = any(kw.arg == "timeout" for kw in node.keywords)
             has_star_kwargs = any(kw.arg is None for kw in node.keywords)
             # positional signature is urlopen(url, data, timeout, ...):
@@ -106,9 +131,9 @@ def main(argv: Sequence[str] = ()) -> int:
     roots = list(argv) or DEFAULT_ROOTS
     problems = check_roots(roots)
     for p in problems:
-        print(p)
+        print(p)  # lint: allow-print
     if problems:
-        print(f"check_reliability: {len(problems)} problem(s)")
+        print(f"check_reliability: {len(problems)} problem(s)")  # lint: allow-print
         return 1
-    print(f"check_reliability: clean ({', '.join(map(str, roots))})")
+    print(f"check_reliability: clean ({', '.join(map(str, roots))})")  # lint: allow-print
     return 0

@@ -74,6 +74,11 @@ class ResilientTrainLoop:
                     "quarantined to %s, falling back to %s", step,
                     type(e).__name__, e, quarantined,
                     self.ckpt.latest_step())
+                from mmlspark_tpu.observability import events
+                if events.events_enabled():
+                    events.emit("event", "restore.fallback", step=step,
+                                error=f"{type(e).__name__}: {e}",
+                                fallback=self.ckpt.latest_step())
                 continue
             return state, step
 

@@ -90,6 +90,14 @@ class Attempt:
         _LOG.warning("%s: attempt %d/%d failed (%s: %s); retrying in %.2fs",
                      p.name, self.number, p.max_attempts,
                      type(exc).__name__, exc, delay)
+        # retries are cold-path by definition; the counter is unconditional,
+        # the event only when an events path is configured
+        from mmlspark_tpu.observability import events, metrics as obsmetrics
+        obsmetrics.counter("reliability.retry_attempts").inc()
+        if events.events_enabled():
+            events.emit("event", "retry.attempt", policy=p.name,
+                        attempt=self.number, delay_s=round(delay, 4),
+                        error=f"{type(exc).__name__}: {exc}")
         if p.on_retry is not None:
             p.on_retry(self.number, exc, delay)
         p.sleep(delay)

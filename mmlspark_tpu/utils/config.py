@@ -36,8 +36,14 @@ _DEFAULTS: Dict[str, Any] = {
     # logging
     "logging.level": "INFO",
     "logging.metrics_every": 0,       # default train-metric log cadence (steps)
+    "logging.history_max": 1000,      # MetricLogger history cap (entries)
     # profiling
     "profiling.trace_dir": "",        # non-empty = capture jax traces here
+    # observability (spans + event log + metrics registry; observability/)
+    "observability.events_path": "",  # non-empty = append JSONL events here
+    "observability.metrics": False,   # hot-path (per-step) metric collection
+    "observability.annotate": False,  # span() also opens a TraceAnnotation
+    "observability.peak_tflops": 197.0,  # MFU denominator (v5e bf16 peak)
 }
 
 _lock = threading.Lock()

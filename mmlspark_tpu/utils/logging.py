@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import logging
 import sys
-import time
+from collections import deque
 from typing import Any, Dict, Optional
 
 from mmlspark_tpu.utils import config
@@ -49,16 +49,28 @@ class MetricLogger:
 
     ``log(step, metrics, batch_rows)`` is cheap when the step is off-cadence
     (no device sync, no string work). On-cadence it converts the device
-    scalar (one sync), computes throughput over the interval, logs, and
-    remembers the history for post-hoc inspection.
+    scalar (one sync), computes throughput over the interval, logs, keeps a
+    bounded history (``logging.history_max`` entries — a million-step run
+    must not grow a million dicts), and forwards through the telemetry layer
+    (registry gauges + a ``train.step`` event when the event log is on), so
+    training metrics ride the same pipeline as every other signal.
+
+    The throughput baseline is established on the FIRST call, not at
+    construction: the gap between construction and the first step holds jit
+    compilation, so an at-construction baseline skews the first interval's
+    ``examples_per_sec`` arbitrarily low. A first call that is itself
+    on-cadence has no measured interval yet and reports rate 0.0.
     """
 
-    def __init__(self, every: Optional[int] = None, name: str = "train"):
+    def __init__(self, every: Optional[int] = None, name: str = "train",
+                 history_max: Optional[int] = None):
         self.every = (config.get("logging.metrics_every")
                       if every is None else every)
         self.log = get_logger(name)
-        self.history: list = []
-        self._last_time = time.perf_counter()
+        self.history: deque = deque(maxlen=(
+            config.get("logging.history_max")
+            if history_max is None else history_max))
+        self._last_time: Optional[float] = None
         self._rows_since = 0
 
     def __call__(self, step: int, metrics: Dict[str, Any],
@@ -66,12 +78,21 @@ class MetricLogger:
         self._rows_since += batch_rows
         if not self.every or step % self.every != 0:
             return
-        now = time.perf_counter()
-        dt = max(now - self._last_time, 1e-9)
-        rate = self._rows_since / dt
+        from mmlspark_tpu.observability import events, metrics as obsmetrics
+        now = events.perf()
+        if self._last_time is None:
+            rate = 0.0  # no baseline yet: unmeasurable, not skewed
+        else:
+            rate = self._rows_since / max(now - self._last_time, 1e-9)
         vals = {k: float(v) for k, v in metrics.items()}
         self.history.append({"step": step, **vals, "examples_per_sec": rate})
         body = " ".join(f"{k}={v:.5g}" for k, v in vals.items())
         self.log.info("step %d %s examples/sec=%.1f", step, body, rate)
+        for k, v in vals.items():
+            obsmetrics.gauge(f"train.{k}").set(v)
+        obsmetrics.gauge("train.examples_per_sec").set(rate)
+        if events.events_enabled():
+            events.emit("metric", "train.step", step=step,
+                        examples_per_sec=round(rate, 3), values=vals)
         self._last_time = now
         self._rows_since = 0

@@ -6,14 +6,24 @@ trace viewable in TensorBoard/Perfetto. The capture dir comes from the
 ``profiling.trace_dir`` config key or the ``trace`` argument, so a
 production run can be flipped into a profiled run by env var alone
 (``MMLSPARK_TPU_PROFILING_TRACE_DIR=/tmp/trace``).
+
+Both hooks are failure-safe: a missing/broken jax profiler backend turns
+them into logged no-ops (a production run must never die because its
+*instrumentation* could not start), and nested ``trace()`` calls — which
+the jax profiler rejects with a hard error — degrade to a warning + no-op
+for the inner call, keeping the outer capture alive.
 """
 from __future__ import annotations
 
 import contextlib
+import threading
 from typing import Iterator, Optional
 
 from mmlspark_tpu.utils import config
 from mmlspark_tpu.utils.logging import get_logger
+
+_lock = threading.Lock()
+_tracing = False
 
 
 @contextlib.contextmanager
@@ -21,20 +31,58 @@ def trace(trace_dir: Optional[str] = None) -> Iterator[None]:
     """Capture a jax profiler trace for the enclosed region.
 
     No-op when neither ``trace_dir`` nor the ``profiling.trace_dir`` config
-    key is set — safe to leave in production code paths.
+    key is set — safe to leave in production code paths. Also a no-op
+    (with a warning) when a trace is already being captured or the
+    profiler backend refuses to start.
     """
+    global _tracing
     target = trace_dir if trace_dir is not None else config.get(
         "profiling.trace_dir")
     if not target:
         yield
         return
-    import jax
-    get_logger("profiling").info("capturing jax trace to %s", target)
-    with jax.profiler.trace(target):
+    with _lock:
+        if _tracing:
+            get_logger("profiling").warning(
+                "nested trace(%s) ignored: a capture is already running",
+                target)
+            nested = True
+        else:
+            _tracing = True
+            nested = False
+    if nested:
         yield
+        return
+    ctx = None
+    try:
+        try:
+            import jax
+            ctx = jax.profiler.trace(target)
+            ctx.__enter__()
+            get_logger("profiling").info("capturing jax trace to %s", target)
+        except Exception as e:
+            ctx = None
+            get_logger("profiling").warning(
+                "jax profiler unavailable (%s: %s); trace() is a no-op",
+                type(e).__name__, e)
+        try:
+            yield
+        finally:
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
+    finally:
+        with _lock:
+            _tracing = False
 
 
 def annotate(name: str):
-    """Named trace region (shows up in the profiler timeline)."""
-    import jax
-    return jax.profiler.TraceAnnotation(name)
+    """Named trace region (shows up in the profiler timeline); degrades to
+    a null context when the jax profiler is unavailable."""
+    try:
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+    except Exception as e:
+        get_logger("profiling").debug(
+            "TraceAnnotation unavailable (%s: %s); annotate(%r) is a no-op",
+            type(e).__name__, e, name)
+        return contextlib.nullcontext()

@@ -113,6 +113,28 @@ def test_accepts_narrow_or_handled_excepts():
     """) == []
 
 
+def test_flags_print_in_library_code():
+    probs = _problems("""
+        def score(frame):
+            print("scoring", frame)
+            return frame
+    """)
+    assert len(probs) == 1 and "print()" in probs[0]
+    assert "mod.py:3" in probs[0]
+    assert "allow-print" in probs[0]  # the fix is named in the message
+
+
+def test_accepts_marked_print_and_non_builtin_print():
+    assert _problems("""
+        def cli_entry(payload):
+            print(payload)  # lint: allow-print (stdout IS the contract)
+
+        def other(obj):
+            obj.print()           # a method, not the builtin
+            pprint(obj)           # different name entirely
+    """) == []
+
+
 def test_syntax_error_is_reported_not_crashing(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text("def broken(:\n")
