@@ -15,7 +15,9 @@ DeepClassifier's default mesh resolution pick them up, so the same script
 scales from laptop CPU to a multi-host slice without edits.
 
 Other subcommands: ``info`` (device + config inventory), ``bench`` (runs
-the repo benchmark when present).
+the repo benchmark when present), ``serve`` (the micro-batching inference
+server over HTTP — docs/SERVING.md), ``check`` (reliability lint),
+``report`` (render a telemetry event log).
 """
 from __future__ import annotations
 
@@ -268,6 +270,65 @@ def cmd_report(args, passthrough) -> int:
     return 0
 
 
+def _parse_model_flag(text: str):
+    """``NAME=ARCH[:JSON-kwargs]`` -> (name, architecture, kwargs)."""
+    name, sep, rest = text.partition("=")
+    if not sep or not name or not rest:
+        raise SystemExit(
+            f"--model: expected NAME=ARCH[:JSON-kwargs], got {text!r}")
+    arch, sep2, blob = rest.partition(":")
+    kwargs = {}
+    if sep2:
+        try:
+            kwargs = json.loads(blob)
+        except json.JSONDecodeError as e:
+            raise SystemExit(f"--model {name}: bad JSON kwargs ({e})")
+        if not isinstance(kwargs, dict):
+            raise SystemExit(
+                f"--model {name}: kwargs must be a JSON object, got "
+                f"{type(kwargs).__name__}")
+    return name, arch, kwargs
+
+
+def cmd_serve(args, passthrough) -> int:
+    """Start the micro-batching inference server behind the stdlib HTTP
+    front-end (docs/SERVING.md). Blocks until interrupted."""
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.serve.http import serve_http
+    from mmlspark_tpu.serve.server import Server
+    if not args.model:
+        raise SystemExit(
+            "serve: at least one --model NAME=ARCH[:JSON-kwargs] required "
+            '(e.g. --model "mlp=mlp_tabular:{\\"input_dim\\": 8}")')
+    models = {}
+    for spec in args.model:
+        name, arch, kwargs = _parse_model_flag(spec)
+        m = JaxModel(inputCol="x", outputCol="y")
+        try:
+            m.set_model(arch, **kwargs)
+        except (KeyError, TypeError, ValueError) as e:
+            raise SystemExit(f"--model {name}: {e}")
+        models[name] = m
+    buckets = [int(b) for b in args.buckets.split(",") if b.strip()] \
+        if args.buckets else None
+    server = Server(models, max_batch=args.max_batch,
+                    max_wait_ms=args.max_wait_ms,
+                    queue_depth=args.queue_depth, buckets=buckets)
+    httpd, addr = serve_http(server, host=args.host, port=args.port)
+    # stdout contract: one JSON line announcing the bound address, so
+    # wrappers can discover an ephemeral --port 0
+    print(json.dumps({"serving": addr,                 # lint: allow-print
+                      "models": server.registry.names()}))
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass  # clean Ctrl-C shutdown path
+    finally:
+        httpd.server_close()
+        server.close()
+    return 0
+
+
 def cmd_bench(args, passthrough) -> int:
     path = os.path.join(os.getcwd(), "bench.py")
     if not os.path.exists(path):
@@ -347,6 +408,28 @@ def main(argv: Optional[List[str]] = None) -> int:
                          help="files/dirs to lint (default: the installed "
                          "mmlspark_tpu package)")
     check_p.set_defaults(fn=cmd_check)
+
+    serve_p = sub.add_parser(
+        "serve",
+        help="serve models over HTTP with dynamic micro-batching")
+    serve_p.add_argument("--model", action="append", default=[],
+                         metavar="NAME=ARCH[:JSON-kwargs]",
+                         help="register a model under NAME (repeatable), "
+                         'e.g. mlp=mlp_tabular:{"input_dim": 8}')
+    serve_p.add_argument("--host", default="127.0.0.1")
+    serve_p.add_argument("--port", type=int, default=8080,
+                         help="0 = pick an ephemeral port (announced on "
+                         "stdout)")
+    serve_p.add_argument("--max-batch", type=int, default=None,
+                         help="rows per micro-batch (serving.max_batch)")
+    serve_p.add_argument("--max-wait-ms", type=float, default=None,
+                         help="max coalescing wait (serving.max_wait_ms)")
+    serve_p.add_argument("--queue-depth", type=int, default=None,
+                         help="admission queue bound (serving.queue_depth)")
+    serve_p.add_argument("--buckets", default="",
+                         help='batch-shape buckets, e.g. "1,8,64" '
+                         "(serving.buckets)")
+    serve_p.set_defaults(fn=cmd_serve)
 
     report_p = sub.add_parser(
         "report", help="render a run report from a telemetry event log")

@@ -254,11 +254,18 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
 
         def bind(jitted):
             if mesh is None:
-                return lambda x: jitted(params, x)
-
-            def call(x):
-                with mesh:
-                    return jitted(params, x)
+                call = lambda x: jitted(params, x)
+            else:
+                def call(x):
+                    with mesh:
+                        return jitted(params, x)
+            # the serving registry AOT-compiles one executable per batch
+            # bucket via jitted.lower(params, spec).compile(); expose the
+            # raw jitted fn + bound params on the closure rather than
+            # widening the transform-path return tuple
+            call._jitted = jitted
+            call._params = params
+            call._mesh = mesh
             return call
 
         def bind_stack(fn):
@@ -531,7 +538,15 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         multi-MB batch here, where a single-element ``np.concatenate``
         still copies and ``astype(float32)`` copies even when the dtype
         already matches — two dataset-sized host copies of pure overhead
-        on the resident fast path."""
+        on the resident fast path.
+
+        Ownership contract: on the single-batch path the emitted column
+        ALIASES ``outs[0]`` (no copy is taken when it is already 2-D
+        float32). Callers hand the buffers over — every internal caller
+        builds ``outs`` from freshly fetched device outputs and drops its
+        reference. A caller that keeps the input reachable and mutates it
+        afterwards would corrupt the scored frame; defensively copy on
+        that side, not here."""
         if not outs:
             out = np.zeros((0, 1), np.float32)
         elif len(outs) == 1:

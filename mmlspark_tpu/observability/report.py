@@ -10,7 +10,10 @@ The offline half of the telemetry loop (``mmlspark-tpu report
 - reliability activity: retry attempts, fault-site hits, checkpoint
   quarantines, by site;
 - throughput: the ``train.fit`` / ``train.step`` summaries the trainer and
-  MetricLogger emit (steps, rows, examples/sec), plus any bench results.
+  MetricLogger emit (steps, rows, examples/sec), plus any bench results;
+- serving: per-request SLO breakdown from the serve subsystem's
+  ``serving.request`` events (p50/p99 total latency, mean queue/pad/compute
+  split, batch occupancy) plus shed/expired counts and the shed rate.
 
 Pure text in, text out — no jax, no framework state — so it runs anywhere
 the log file can be copied to.
@@ -42,6 +45,20 @@ def load_events(path: str) -> List[Dict[str, Any]]:
         get_logger("observability.report").warning(
             "%s: skipped %d malformed line(s)", path, bad)
     return events
+
+
+def _pct(sorted_vals: List[float], p: float) -> float:
+    """Nearest-rank percentile of an ascending list (0 when empty)."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def _mean(events: List[Dict[str, Any]], field: str) -> float:
+    vals = [float(e.get(field, 0.0)) for e in events]
+    return sum(vals) / len(vals) if vals else 0.0
 
 
 def _table(rows: List[List[str]], header: List[str]) -> List[str]:
@@ -117,6 +134,35 @@ def render_report(path: str, top: int = 10) -> str:
             steps = [e.get("step") for e in quarantines]
             out.append(f"  checkpoint quarantines: {len(quarantines)} "
                        f"(steps {steps})")
+        out.append("")
+
+    # -- serving -------------------------------------------------------------
+    serving = [e for e in events if e.get("type") == "serving"]
+    reqs = [e for e in serving if e.get("name") == "request"]
+    shed = [e for e in serving if e.get("name") == "shed"]
+    expired = [e for e in serving if e.get("name") == "expired"]
+    if serving:
+        out.append("serving:")
+        if reqs:
+            totals = sorted(float(e.get("total_ms", 0.0)) for e in reqs)
+            by_model: Dict[str, int] = defaultdict(int)
+            for e in reqs:
+                by_model[e.get("model", "?")] += 1
+            detail = ", ".join(f"{k}={v}" for k, v in sorted(by_model.items()))
+            out.append(
+                f"  requests: {len(reqs)} completed ({detail}); "
+                f"latency p50={_pct(totals, 50):.3f}ms "
+                f"p99={_pct(totals, 99):.3f}ms")
+            out.append(
+                f"  mean split: queue={_mean(reqs, 'queue_ms'):.3f}ms "
+                f"pad={_mean(reqs, 'pad_ms'):.3f}ms "
+                f"compute={_mean(reqs, 'compute_ms'):.3f}ms; "
+                f"batch occupancy mean="
+                f"{_mean(reqs, 'occupancy'):.2f}")
+        offered = len(reqs) + len(shed)
+        rate = (100.0 * len(shed) / offered) if offered else 0.0
+        out.append(f"  shed: {len(shed)} ({rate:.1f}% of offered), "
+                   f"expired: {len(expired)}")
         out.append("")
 
     # -- throughput ----------------------------------------------------------

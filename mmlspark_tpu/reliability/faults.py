@@ -18,6 +18,9 @@ Instrumented sites (grep for ``fault_site(`` to confirm the live list):
 - ``checkpoint.restore``  — before the orbax restore
 - ``readers.read``        — carries each binary file/zip-entry payload
 - ``trainer.train_step``  — before each sharded train step
+- ``serve.enqueue``       — before a request enters the admission queue
+- ``serve.batch``         — after a micro-batch is dequeued, pre-padding
+- ``serve.score``         — before the batch hits the compiled program
 
 Usage::
 

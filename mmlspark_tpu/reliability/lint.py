@@ -16,6 +16,12 @@ invisible to log levels, event logs, and run reports. Route through
 CONTRACT is stdout (e.g. ``mmlspark-tpu info`` printing JSON) mark the
 line with ``# lint: allow-print``.
 
+Rule 4 — ``threading.Thread(...)`` without an explicit ``daemon=``: the
+default (inherit the creator's daemon flag) decides whether interpreter
+shutdown BLOCKS on the thread, and an implicit choice is how a serving
+executor or prefetch worker quietly turns Ctrl-C into a hang. Every
+library-code thread states its shutdown contract at the constructor.
+
 Shared core for ``tools/check_reliability.py`` (standalone CLI),
 ``mmlspark-tpu check`` (installed CLI), and the in-pytest gate
 (tests/test_reliability_lint.py) — same single source of truth pattern as
@@ -36,6 +42,12 @@ def _is_urlopen(call: ast.Call) -> bool:
     f = call.func
     return (isinstance(f, ast.Name) and f.id == "urlopen") or \
         (isinstance(f, ast.Attribute) and f.attr == "urlopen")
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Name) and f.id == "Thread") or \
+        (isinstance(f, ast.Attribute) and f.attr == "Thread")
 
 
 def _catches_everything(node: ast.expr) -> bool:
@@ -69,6 +81,14 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
                 f"{filename}:{node.lineno}: print() in library code "
                 "(route through get_logger or the event log; stdout CLI "
                 f"contracts mark the line `{_ALLOW_PRINT}`)")
+        elif isinstance(node, ast.Call) and _is_thread_ctor(node):
+            has_daemon = any(kw.arg == "daemon" for kw in node.keywords)
+            has_star_kwargs = any(kw.arg is None for kw in node.keywords)
+            if not (has_daemon or has_star_kwargs):
+                problems.append(
+                    f"{filename}:{node.lineno}: Thread() without explicit "
+                    "daemon= (state the shutdown contract; an inherited "
+                    "flag hangs or kills by accident)")
         elif isinstance(node, ast.Call) and _is_urlopen(node):
             has_timeout = any(kw.arg == "timeout" for kw in node.keywords)
             has_star_kwargs = any(kw.arg is None for kw in node.keywords)

@@ -42,11 +42,19 @@ def default_retryable(exc: BaseException) -> bool:
     """Transient-I/O default: the OSError family retries (URLError,
     ConnectionError, socket timeouts, truncated-read IOErrors), EXCEPT
     definitive HTTP client errors — a 404 will 404 again, but a 429 or any
-    5xx is the server asking for a retry."""
+    5xx is the server asking for a retry.
+
+    Beyond I/O, any exception may opt in by carrying a truthy
+    ``retryable`` attribute — the protocol load-shedding errors use
+    (``serve.ServerOverloaded`` sets ``retryable = True`` as a class
+    attribute) so new transient failure types classify correctly here
+    without this module importing their packages."""
     from urllib.error import HTTPError
     if isinstance(exc, HTTPError):
         return exc.code == 429 or exc.code >= 500
-    return isinstance(exc, (OSError, TimeoutError))
+    if isinstance(exc, (OSError, TimeoutError)):
+        return True
+    return bool(getattr(exc, "retryable", False))
 
 
 def _unit(seed: int, attempt: int) -> float:

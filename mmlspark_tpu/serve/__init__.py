@@ -1,0 +1,19 @@
+"""Online serving: dynamic micro-batching inference under SLO telemetry.
+
+The request-level counterpart to ``JaxModel.transform``'s whole-frame
+scoring — see ``docs/SERVING.md`` for architecture, the ``serving.*``
+config namespace, and overload/retry semantics.
+"""
+from mmlspark_tpu.serve.batcher import (      # noqa: F401
+    MicroBatcher, Ticket, bucket_for, default_buckets, parse_buckets,
+)
+from mmlspark_tpu.serve.registry import ModelEntry, ModelRegistry  # noqa: F401
+from mmlspark_tpu.serve.server import (        # noqa: F401
+    RequestExpired, ServeError, Server, ServerClosed, ServerOverloaded,
+)
+
+__all__ = [
+    "MicroBatcher", "Ticket", "bucket_for", "default_buckets",
+    "parse_buckets", "ModelEntry", "ModelRegistry", "Server",
+    "ServeError", "ServerOverloaded", "RequestExpired", "ServerClosed",
+]

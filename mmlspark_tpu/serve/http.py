@@ -1,0 +1,127 @@
+"""Stdlib HTTP front-end for :class:`~mmlspark_tpu.serve.server.Server`.
+
+JSON in, JSON out, zero new dependencies — the transport half of
+``mmlspark-tpu serve``. Endpoints:
+
+- ``POST /score`` — body ``{"model": "name", "x": [[...], ...],
+  "deadline_ms": 50}`` (``x`` one row or a list of rows; ``deadline_ms``
+  optional). 200 -> ``{"y": [[...], ...]}``. Error mapping keeps the
+  server's admission semantics visible to HTTP clients:
+  ``ServerOverloaded`` -> **503** (with ``Retry-After: 0``, the
+  HTTP-native "retryable" signal — ``default_retryable`` already treats
+  5xx as retryable on the client side), ``RequestExpired`` -> **504**,
+  unknown model / malformed body -> **400**.
+- ``GET /healthz`` — liveness + :meth:`Server.stats`.
+- ``GET /models`` — registered model names.
+- ``GET /metrics`` — Prometheus text exposition of the process registry.
+
+``ThreadingHTTPServer`` gives one thread per connection; they all funnel
+into the server's bounded queue, so concurrency is capped by admission
+control, not by transport threads. Request logging routes through the
+framework logger (debug level), not BaseHTTPRequestHandler's stderr
+``log_message``.
+"""
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.observability import metrics
+from mmlspark_tpu.serve.server import (
+    RequestExpired, ServeError, Server, ServerOverloaded,
+)
+from mmlspark_tpu.utils.logging import get_logger
+
+logger = get_logger("serve.http")
+
+MAX_BODY_BYTES = 64 * 1024 * 1024   # one request never buffers more
+
+
+def make_handler(server: Server):
+    """Handler class bound to one :class:`Server` (stdlib handlers are
+    instantiated per request; the closure carries the server)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):   # route, don't print
+            logger.debug("http %s", fmt % args)
+
+        def _reply(self, code: int, payload: dict,
+                   headers: Optional[dict] = None) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, {"status": "ok", "stats": server.stats()})
+            elif self.path == "/models":
+                self._reply(200, {"models": server.registry.names()})
+            elif self.path == "/metrics":
+                text = metrics.get_registry().prometheus_text()
+                body = text.encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/score":
+                self._reply(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                if n > MAX_BODY_BYTES:
+                    self._reply(413, {"error": "body too large"})
+                    return
+                req = json.loads(self.rfile.read(n))
+                model = req["model"]
+                x = np.asarray(req["x"])
+                deadline_ms = req.get("deadline_ms")
+            except (KeyError, ValueError, TypeError) as e:
+                self._reply(400, {"error": f"bad request: {e}"})
+                return
+            try:
+                if x.ndim <= 1:
+                    y = server.submit(model, x, deadline_ms)
+                else:
+                    y = server.submit_many(model, x, deadline_ms)
+            except ServerOverloaded as e:
+                self._reply(503, {"error": str(e), "retryable": True},
+                            headers={"Retry-After": "0"})
+            except RequestExpired as e:
+                self._reply(504, {"error": str(e)})
+            except (KeyError, ValueError) as e:
+                self._reply(400, {"error": str(e)})
+            except ServeError as e:
+                self._reply(500, {"error": str(e)})
+            else:
+                self._reply(200, {"y": np.asarray(y).tolist()})
+
+    return Handler
+
+
+def serve_http(server: Server, host: str = "127.0.0.1", port: int = 8080,
+               poll_s: float = 0.5) -> Tuple[ThreadingHTTPServer, str]:
+    """Bind and return ``(httpd, "host:port")`` without blocking; callers
+    run ``httpd.serve_forever()`` (the CLI does) or drive
+    ``handle_request`` themselves (tests)."""
+    httpd = ThreadingHTTPServer((host, port), make_handler(server))
+    httpd.timeout = poll_s
+    addr = f"{httpd.server_address[0]}:{httpd.server_address[1]}"
+    logger.info("serving on http://%s (models: %s)",
+                addr, ", ".join(server.registry.names()) or "none")
+    return httpd, addr

@@ -1,0 +1,209 @@
+"""Multi-model serving registry: warm params in HBM, one compile per bucket.
+
+Each registered :class:`~mmlspark_tpu.models.jax_model.JaxModel` gets a
+:class:`ModelEntry` that owns the serving-side compiled artifacts:
+
+- the model's bound apply closure (params already device-resident), built
+  through the same ``_cached_jit`` key ``transform`` uses, so serving and
+  offline scoring share one program cache and one numerics path;
+- one AOT-compiled executable per batch bucket
+  (``jitted.lower(params, ShapeDtypeStruct).compile()``), created by the
+  :meth:`ModelEntry._compile` hook — the seam the compile-discipline test
+  wraps to count compilations. Scoring a request NEVER triggers a compile
+  outside this hook.
+
+Residency follows the ``runtime.device_cache_mb`` budget that already
+governs :mod:`~mmlspark_tpu.models.residency` and DeviceEpochCache: the
+summed param bytes of warm entries must fit, and touching a model bumps it
+to most-recently-used while colder entries are evicted (compiled programs
+and the jit cache dropped, so the param tree they pin becomes collectable).
+An evicted model is NOT unregistered — the next request re-warms it, paying
+its compile again. Size the budget so the steady-state working set stays
+warm.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.utils import config as mmlconfig
+
+
+def _param_bytes(params) -> int:
+    import jax
+    return sum(int(np.prod(l.shape)) * l.dtype.itemsize
+               for l in jax.tree_util.tree_leaves(params)
+               if hasattr(l, "shape"))
+
+
+class ModelEntry:
+    """One served model: coercion spec, bound apply, per-bucket programs."""
+
+    def __init__(self, name: str, model):
+        self.name = name
+        self.model = model
+        self._spec = model._spec()
+        self._apply = None
+        self._compiled: Dict[Tuple, Callable] = {}
+        self.compile_count = 0
+
+    # -- warm-up ----------------------------------------------------------
+    def ensure_apply(self):
+        """The model's bound apply, built lazily through the SAME
+        ``_cached_jit`` key as ``JaxModel.transform`` — registering a model
+        that was already used offline reuses its closure (and vice versa)."""
+        if self._apply is None:
+            m = self.model
+            apply, _, _, _ = m._cached_jit(
+                lambda: m._build_apply(),
+                key=(m.architecture, repr(m.get("architectureArgs")),
+                     m.outputNodeName, repr(m.get("devicePreprocess")),
+                     repr(m.get("meshSpec")), m.get("computeDtype"),
+                     ))
+            self._apply = apply
+        return self._apply
+
+    def coerce(self, arr) -> np.ndarray:
+        """Host-side input coercion, identical to the offline scoring path
+        (same ``_coerce_batch``), so served results are bit-identical to
+        ``transform`` of the same rows."""
+        return self.model._coerce_batch(np.asarray(arr), self._spec)
+
+    # -- compile discipline ------------------------------------------------
+    def _compile(self, bucket: int, row_shape: Tuple[int, ...],
+                 dtype) -> Callable[[np.ndarray], np.ndarray]:
+        """Build the executable for one (bucket, row-shape, dtype) batch
+        shape. THE compile seam: every serving-path compilation funnels
+        through here exactly once per key — tests wrap this method to
+        assert the at-most-one-compile-per-bucket discipline.
+
+        Single-device models AOT-compile (``lower().compile()``): the cost
+        is paid at a deterministic point (first request of a bucket, or an
+        explicit warmup), never re-traced. Mesh-bound models fall back to
+        the bound apply — ``jax.jit`` under a mesh context still compiles
+        once per shape, the bucketing still bounds the shape set."""
+        import jax
+        apply = self.ensure_apply()
+        jitted = getattr(apply, "_jitted", None)
+        if jitted is None or getattr(apply, "_mesh", None) is not None:
+            return apply
+        spec = jax.ShapeDtypeStruct((bucket,) + tuple(row_shape), dtype)
+        compiled = jitted.lower(apply._params, spec).compile()
+        params = apply._params
+        return lambda x: compiled(params, x)
+
+    def program_for(self, bucket: int,
+                    x: np.ndarray) -> Callable[[np.ndarray], np.ndarray]:
+        key = (bucket, x.shape[1:], str(x.dtype))
+        prog = self._compiled.get(key)
+        if prog is None:
+            prog = self._compile(bucket, x.shape[1:], x.dtype)
+            self._compiled[key] = prog
+            self.compile_count += 1
+        return prog
+
+    def score(self, x: np.ndarray) -> np.ndarray:
+        """Score one padded bucket-shaped batch -> host float32 rows."""
+        out = np.asarray(self.program_for(x.shape[0], x)(x))
+        if out.ndim == 1:
+            out = out[:, None]
+        return np.asarray(out, np.float32)
+
+    # -- residency ---------------------------------------------------------
+    def resident_bytes(self) -> int:
+        """Param bytes this entry pins in HBM (0 when cold)."""
+        if self._apply is None:
+            return 0
+        params = getattr(self._apply, "_params", None)
+        return _param_bytes(params) if params is not None else 0
+
+    @property
+    def warm(self) -> bool:
+        return self._apply is not None
+
+    def evict(self) -> None:
+        """Drop compiled programs AND the model's jit cache so the param
+        tree they capture becomes collectable (the closure in
+        ``_jit_cache`` pins params; clearing only ``_compiled`` would free
+        nothing)."""
+        self._apply = None
+        self._compiled.clear()
+        self.model._jit_cache = None
+        self.model._out_spec_cache = None
+
+
+class ModelRegistry:
+    """Name -> :class:`ModelEntry`, LRU-bounded by ``runtime.device_cache_mb``.
+
+    Thread-safe for registration and lookup; entry warm-up and scoring are
+    serialized by the server's single executor thread.
+    """
+
+    def __init__(self, budget_mb: Optional[float] = None):
+        self._budget_mb = budget_mb
+        self._entries: "OrderedDict[str, ModelEntry]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.evictions = 0
+
+    def budget_bytes(self) -> float:
+        mb = self._budget_mb
+        if mb is None:
+            mb = float(mmlconfig.get("runtime.device_cache_mb"))
+        return mb * 1e6
+
+    def add(self, name: str, model) -> ModelEntry:
+        with self._lock:
+            if name in self._entries:
+                raise ValueError(f"model {name!r} already registered")
+            entry = ModelEntry(name, model)
+            self._entries[name] = entry
+            return entry
+
+    def get(self, name: str) -> ModelEntry:
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                raise KeyError(
+                    f"unknown model {name!r}; registered: {self.names()}")
+            self._entries.move_to_end(name)   # MRU
+            return entry
+
+    def names(self) -> List[str]:
+        return sorted(self._entries)
+
+    def touch(self, entry: ModelEntry) -> None:
+        """After warming ``entry``, evict LRU entries until the warm set
+        fits the budget. ``entry`` itself is exempt — a single over-budget
+        model still serves (matching residency's force semantics), it just
+        evicts everyone else."""
+        with self._lock:
+            budget = self.budget_bytes()
+            while self._resident() > budget:
+                victim = next(
+                    (e for e in self._entries.values()
+                     if e.warm and e is not entry), None)
+                if victim is None:
+                    break
+                victim.evict()
+                self.evictions += 1
+
+    def _resident(self) -> int:
+        return sum(e.resident_bytes() for e in self._entries.values())
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._resident()
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "models": len(self._entries),
+                "warm": sum(1 for e in self._entries.values() if e.warm),
+                "resident_bytes": self._resident(),
+                "evictions": self.evictions,
+                "compiles": sum(e.compile_count
+                                for e in self._entries.values()),
+            }

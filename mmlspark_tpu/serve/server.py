@@ -1,0 +1,359 @@
+"""In-process inference server: admission -> micro-batch -> score -> respond.
+
+The request-level front half the ROADMAP's "serves heavy traffic" north
+star needs and the reference never had (``CNTKModel`` scored whole
+DataFrames; a request had to wait for a batch job). The shape:
+
+- **Admission** (caller threads): inputs are coerced through the model's
+  own ``_coerce_batch`` (so served numerics are bit-identical to offline
+  ``transform``), wrapped in a :class:`~mmlspark_tpu.serve.batcher.Ticket`
+  and pushed into a BOUNDED queue. A full queue rejects immediately with
+  :class:`ServerOverloaded` (``retryable = True`` — ``RetryPolicy``'s
+  default classifier backs off and retries it) instead of growing latency
+  unboundedly: shed early, shed cheap.
+- **One executor thread** owns the device: it drains the queue into a
+  :class:`~mmlspark_tpu.serve.batcher.MicroBatcher`, flushes on
+  ``max_batch``/``max_wait_ms``, cancels tickets whose deadline passed
+  while queued (:class:`RequestExpired` — never scored, the work is
+  already worthless), pads the group to a compiled bucket, and scores it
+  through the :class:`~mmlspark_tpu.serve.registry.ModelRegistry`. Single
+  ownership means no device-side locking and a deterministic batch
+  sequence for fault replay.
+- **Telemetry**: admitted/shed/expired/completed counters are
+  unconditional; queue-depth + batch-occupancy gauges and the
+  queue/pad/compute latency histograms gate on ``metrics_enabled()``; one
+  ``serving.request`` event per request (the report's p50/p99 source) and
+  ``serving.shed``/``serving.expired`` events gate on the event log.
+- **Fault sites** ``serve.enqueue`` / ``serve.batch`` / ``serve.score``
+  let a FaultPlan replay overload and mid-batch-crash scenarios
+  deterministically (a ``serve.score`` raise fails that batch's futures
+  and the executor keeps serving — the blast radius of a bad batch is
+  that batch).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.observability import events, metrics
+from mmlspark_tpu.reliability.faults import fault_site
+from mmlspark_tpu.serve.batcher import (
+    MicroBatcher, Ticket, bucket_for, default_buckets, parse_buckets,
+)
+from mmlspark_tpu.serve.registry import ModelRegistry
+from mmlspark_tpu.utils import config as mmlconfig
+from mmlspark_tpu.utils.logging import get_logger
+
+logger = get_logger("serve")
+
+_STOP = object()
+
+
+class ServeError(RuntimeError):
+    """Base for serving-path failures."""
+
+
+class ServerOverloaded(ServeError):
+    """Admission rejected: the bounded queue is full. Retryable by
+    contract — ``reliability.retry.default_retryable`` reads this class
+    attribute, so a client wrapping ``submit`` in ``RetryPolicy`` backs
+    off and retries without custom classification."""
+    retryable = True
+
+
+class RequestExpired(ServeError):
+    """The request's deadline passed before scoring started; it was
+    cancelled at dequeue, not computed. NOT retryable by default — the
+    caller's deadline already elapsed, retrying is their call."""
+
+
+class ServerClosed(ServeError):
+    """Submitted to a server after ``close()``."""
+
+
+class Server:
+    """Dynamic micro-batching inference server over a model registry.
+
+    ``models`` maps serving names to fitted
+    :class:`~mmlspark_tpu.models.jax_model.JaxModel`-like stages (anything
+    with ``_spec``/``_coerce_batch``/``_build_apply``). Knobs default from
+    the ``serving.*`` config namespace. ``start=False`` leaves the
+    executor unstarted — tests drive admission and ``_flush`` directly
+    for deterministic overload/expiry coverage.
+    """
+
+    def __init__(self, models: Dict[str, object], *,
+                 max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 clock=None, start: bool = True):
+        self.max_batch = int(max_batch if max_batch is not None
+                             else mmlconfig.get("serving.max_batch"))
+        wait_ms = float(max_wait_ms if max_wait_ms is not None
+                        else mmlconfig.get("serving.max_wait_ms"))
+        self.max_wait_s = wait_ms / 1e3
+        depth = int(queue_depth if queue_depth is not None
+                    else mmlconfig.get("serving.queue_depth"))
+        if buckets is None:
+            text = str(mmlconfig.get("serving.buckets"))
+            self.buckets = parse_buckets(text, self.max_batch) if text \
+                else default_buckets(self.max_batch)
+        else:
+            self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+            if self.buckets[-1] < self.max_batch:
+                raise ValueError(
+                    f"largest bucket {self.buckets[-1]} < max_batch "
+                    f"{self.max_batch}")
+        self.clock = clock if clock is not None else events.perf
+        self.registry = ModelRegistry()
+        for name, model in models.items():
+            self.registry.add(name, model)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._batcher = MicroBatcher(self.max_batch, self.max_wait_s,
+                                     clock=self.clock)
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        # counters are unconditional (lock + int add); gauges/histograms
+        # gate per-use on metrics_enabled()
+        self._admitted = metrics.counter("serving.admitted")
+        self._shed = metrics.counter("serving.shed")
+        self._expired = metrics.counter("serving.expired")
+        self._completed = metrics.counter("serving.completed")
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="mmlspark-tpu-serve", daemon=True)
+        self._thread.start()
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the executor. ``drain=True`` scores everything already
+        admitted first; ``drain=False`` fails pending work with
+        :class:`ServerClosed`."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread is not None:
+            self._queue.put(_STOP)
+            self._thread.join(timeout=60.0)
+            if self._thread.is_alive():
+                logger.warning("serve executor did not stop within 60s")
+            self._thread = None
+        leftovers = [t for t in self._drain_tickets() if t is not _STOP]
+        if drain:
+            for t in leftovers:
+                self._batcher.offer(t)
+            while len(self._batcher):
+                self._flush()
+        else:
+            while len(self._batcher):
+                leftovers.extend(self._batcher.take())
+            for t in leftovers:
+                t.future.set_exception(ServerClosed("server closed"))
+        if events.events_enabled():
+            s = self.stats()
+            events.emit("serving", "summary", **s)
+
+    def __enter__(self) -> "Server":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- submission (caller threads) --------------------------------------
+    def submit_async(self, model: str, x,
+                     deadline_ms: Optional[float] = None) -> Future:
+        """Admit one request (a single example or a small batch of rows up
+        to ``max_batch``); returns a Future resolving to the scored rows
+        (float32, one row per input row). Raises :class:`ServerOverloaded`
+        synchronously when the queue is full."""
+        if self._closed:
+            raise ServerClosed("server closed")
+        entry = self.registry.get(model)   # KeyError surfaces here, early
+        arr = np.asarray(x)
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        coerced = entry.coerce(arr)
+        if coerced.shape[0] > self.max_batch:
+            raise ValueError(
+                f"{coerced.shape[0]} rows > max_batch {self.max_batch}; "
+                "use submit_many for large arrays")
+        now = self.clock()
+        if deadline_ms is None:
+            dms = float(mmlconfig.get("serving.default_deadline_ms"))
+            deadline_ms = dms if dms > 0 else None
+        deadline = now + deadline_ms / 1e3 if deadline_ms else None
+        ticket = Ticket(model, coerced, coerced.shape[0], Future(),
+                        enqueued=now, deadline=deadline)
+        fault_site("serve.enqueue", {"model": model,
+                                     "rows": ticket.rows})
+        try:
+            self._queue.put_nowait(ticket)
+        except queue.Full:
+            self._shed.inc()
+            if events.events_enabled():
+                events.emit("serving", "shed", model=model,
+                            rows=ticket.rows)
+            raise ServerOverloaded(
+                f"queue full ({self._queue.maxsize} pending); retry with "
+                "backoff") from None
+        self._admitted.inc()
+        if metrics.metrics_enabled():
+            metrics.gauge("serving.queue_depth").set(self._queue.qsize())
+        return ticket.future
+
+    def submit(self, model: str, x,
+               deadline_ms: Optional[float] = None,
+               timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking :meth:`submit_async`."""
+        return self.submit_async(model, x, deadline_ms).result(timeout)
+
+    def submit_many(self, model: str, x,
+                    deadline_ms: Optional[float] = None,
+                    timeout: Optional[float] = None) -> np.ndarray:
+        """Score a large array by splitting it into ``max_batch``-row
+        requests admitted back-to-back (they coalesce into full batches),
+        then reassembling in order."""
+        arr = np.asarray(x)
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        futures = [self.submit_async(model, arr[i:i + self.max_batch],
+                                     deadline_ms)
+                   for i in range(0, arr.shape[0], self.max_batch)]
+        return np.concatenate([f.result(timeout) for f in futures], axis=0)
+
+    # -- executor ----------------------------------------------------------
+    def _run(self) -> None:
+        stopping = False
+        while True:
+            wait = self._batcher.wait_s()
+            try:
+                item = self._queue.get(timeout=wait)
+            except queue.Empty:
+                item = None          # deadline flush fires below
+            if item is _STOP:
+                stopping = True
+            elif item is not None:
+                self._batcher.offer(item)
+            # opportunistic drain: everything already queued joins this
+            # coalescing round without further blocking
+            for t in self._drain_tickets():
+                if t is _STOP:      # pragma: no cover - close() races
+                    stopping = True
+                else:
+                    self._batcher.offer(t)
+            if metrics.metrics_enabled():
+                metrics.gauge("serving.queue_depth").set(self._queue.qsize())
+            while self._batcher.ready() \
+                    or (stopping and len(self._batcher)):
+                self._flush()
+            if stopping:
+                return
+
+    def _drain_tickets(self) -> List:
+        out: List = []
+        while True:
+            try:
+                out.append(self._queue.get_nowait())
+            except queue.Empty:
+                return out
+
+    def _flush(self) -> None:
+        """Dequeue one head group, cancel expired tickets, pad to a
+        bucket, score, resolve futures. Any failure fails THIS group's
+        futures and leaves the executor serving."""
+        t_dequeue = self.clock()
+        group = self._batcher.take()
+        live: List[Ticket] = []
+        for t in group:
+            if t.expired(t_dequeue):
+                self._expired.inc()
+                if events.events_enabled():
+                    events.emit("serving", "expired", model=t.model,
+                                rows=t.rows,
+                                waited_ms=round(
+                                    (t_dequeue - t.enqueued) * 1e3, 3))
+                t.future.set_exception(RequestExpired(
+                    f"deadline passed {t_dequeue - t.deadline:.3f}s before "
+                    "scoring"))
+            else:
+                live.append(t)
+        if not live:
+            return
+        try:
+            rows = sum(t.rows for t in live)
+            fault_site("serve.batch", {"model": live[0].model,
+                                       "tickets": len(live), "rows": rows})
+            bucket = bucket_for(rows, self.buckets)
+            x = np.concatenate([t.x for t in live], axis=0) \
+                if len(live) > 1 else live[0].x
+            if rows < bucket:
+                pad = np.zeros((bucket - rows,) + x.shape[1:], x.dtype)
+                x = np.concatenate([x, pad], axis=0)
+            t_padded = self.clock()
+            entry = self.registry.get(live[0].model)
+            entry.ensure_apply()
+            self.registry.touch(entry)
+            fault_site("serve.score", {"model": entry.name,
+                                       "bucket": bucket})
+            out = entry.score(x)
+            t_scored = self.clock()
+            self._respond(live, out, bucket, rows,
+                          t_dequeue, t_padded, t_scored)
+        except Exception as e:  # fail the group, keep serving
+            logger.error("serve batch failed: %s", e)
+            for t in live:
+                if not t.future.done():
+                    t.future.set_exception(e)
+
+    def _respond(self, live: List[Ticket], out: np.ndarray, bucket: int,
+                 rows: int, t_dequeue: float, t_padded: float,
+                 t_scored: float) -> None:
+        hot = metrics.metrics_enabled()
+        log = events.events_enabled()
+        pad_s = t_padded - t_dequeue
+        compute_s = t_scored - t_padded
+        if hot:
+            metrics.gauge("serving.batch_occupancy").set(rows / bucket)
+            metrics.histogram("serving.pad_ms").observe(pad_s * 1e3)
+            metrics.histogram("serving.compute_ms").observe(compute_s * 1e3)
+        offset = 0
+        for t in live:
+            res = out[offset:offset + t.rows]
+            offset += t.rows
+            queue_s = t_dequeue - t.enqueued
+            total_s = t_scored - t.enqueued
+            self._completed.inc()
+            if hot:
+                metrics.histogram("serving.queue_ms").observe(queue_s * 1e3)
+                metrics.histogram("serving.total_ms").observe(total_s * 1e3)
+            if log:
+                events.emit("serving", "request", model=t.model,
+                            rows=t.rows, bucket=bucket,
+                            occupancy=round(rows / bucket, 4),
+                            queue_ms=round(queue_s * 1e3, 3),
+                            pad_ms=round(pad_s * 1e3, 3),
+                            compute_ms=round(compute_s * 1e3, 3),
+                            total_ms=round(total_s * 1e3, 3))
+            t.future.set_result(res)
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> Dict[str, float]:
+        s = {"admitted": self._admitted.value,
+             "shed": self._shed.value,
+             "expired": self._expired.value,
+             "completed": self._completed.value,
+             "queue_depth": self._queue.qsize(),
+             "pending_rows": self._batcher.pending_rows}
+        s.update({f"registry.{k}": v
+                  for k, v in self.registry.stats().items()})
+        return s

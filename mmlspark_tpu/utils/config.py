@@ -33,6 +33,14 @@ _DEFAULTS: Dict[str, Any] = {
     "reliability.http_timeout": 30.0,  # seconds per urlopen (downloader)
     "reliability.max_attempts": 3,     # default RetryPolicy attempt cap
     "reliability.base_delay": 0.2,     # first backoff delay (seconds)
+    # serving (dynamic micro-batching inference server; serve/ package)
+    "serving.max_batch": 64,          # rows per flushed micro-batch
+    "serving.max_wait_ms": 5.0,       # max coalescing wait before flush
+    "serving.queue_depth": 256,       # bounded admission queue (overload
+                                      # beyond this sheds, never queues)
+    "serving.buckets": "",            # "" = {1, max/8, max/2, max}; else
+                                      # e.g. "1,8,64" (largest >= max_batch)
+    "serving.default_deadline_ms": 0.0,  # 0 = requests never expire
     # logging
     "logging.level": "INFO",
     "logging.metrics_every": 0,       # default train-metric log cadence (steps)

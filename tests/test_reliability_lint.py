@@ -135,6 +135,38 @@ def test_accepts_marked_print_and_non_builtin_print():
     """) == []
 
 
+def test_flags_thread_without_explicit_daemon():
+    probs = _problems("""
+        import threading
+
+        def spawn(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            return t
+    """)
+    assert len(probs) == 1 and "daemon=" in probs[0]
+    assert "mod.py:5" in probs[0]
+
+
+def test_accepts_thread_with_explicit_daemon_either_way():
+    assert _problems("""
+        import threading
+        from threading import Thread
+
+        def a(fn):
+            return threading.Thread(target=fn, daemon=True)
+
+        def b(fn):
+            return Thread(target=fn, daemon=False)  # explicit is the point
+
+        def c(fn, **kw):
+            return Thread(target=fn, **kw)          # caller decides
+
+        def d(obj):
+            return obj.thread()                      # not a Thread ctor
+    """) == []
+
+
 def test_syntax_error_is_reported_not_crashing(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text("def broken(:\n")

@@ -1,0 +1,467 @@
+"""Online serving subsystem (serve/): micro-batching, admission control,
+compile discipline, SLO telemetry.
+
+Everything runs on CPU with either real (sub-second) concurrency or an
+injected clock — no sleeps, no flaky timing assertions. The acceptance
+spine:
+
+- served results are BIT-IDENTICAL to direct ``JaxModel`` scoring of the
+  same rows (micro-batching + bucket padding must not change numerics);
+- overload sheds immediately (``ServerOverloaded``, retryable) instead of
+  queuing unboundedly;
+- expired requests are cancelled at dequeue, never scored;
+- at most one compilation per configured bucket (counted via the wrapped
+  ``ModelEntry._compile`` hook);
+- ``mmlspark-tpu report`` renders a serving section (p50/p99,
+  shed/expired) from a captured event log.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.frame import Frame
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.observability import events, metrics
+from mmlspark_tpu.serve import (
+    MicroBatcher, RequestExpired, Server, ServerClosed, ServerOverloaded,
+    Ticket, bucket_for, default_buckets, parse_buckets,
+)
+from mmlspark_tpu.serve import registry as registry_mod
+from mmlspark_tpu.utils import config
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics.get_registry().reset()
+    yield
+    metrics.get_registry().reset()
+
+
+def make_model(dim=8, classes=3, seed=0):
+    m = JaxModel(inputCol="x", outputCol="y", miniBatchSize=8)
+    m.set_model("mlp_tabular", input_dim=dim, hidden=[16],
+                num_classes=classes, seed=seed)
+    return m
+
+
+def _ticker(start=0.0):
+    state = {"now": float(start)}
+
+    def clock():
+        return state["now"]
+    clock.advance = lambda dt: state.__setitem__("now", state["now"] + dt)
+    return clock
+
+
+# -- batcher core (pure, injected clock) -------------------------------------
+
+def _ticket(model="m", rows=1, at=0.0, deadline=None):
+    return Ticket(model, np.zeros((rows, 4), np.float32), rows,
+                  future=None, enqueued=at, deadline=deadline)
+
+
+def test_max_wait_flushes_partial_batch_injected_clock():
+    clock = _ticker()
+    b = MicroBatcher(max_batch=8, max_wait_s=0.005, clock=clock)
+    b.offer(_ticket(rows=2, at=clock()))
+    assert not b.ready()              # 2 of 8 rows, no time elapsed
+    assert b.wait_s() == pytest.approx(0.005)
+    clock.advance(0.004)
+    assert not b.ready()
+    assert b.wait_s() == pytest.approx(0.001)
+    clock.advance(0.002)              # oldest ticket now past max_wait
+    assert b.ready()
+    group = b.take()
+    assert [t.rows for t in group] == [2]
+    assert len(b) == 0 and b.wait_s() is None
+
+
+def test_full_batch_flushes_without_waiting():
+    clock = _ticker()
+    b = MicroBatcher(max_batch=4, max_wait_s=60.0, clock=clock)
+    for _ in range(5):
+        b.offer(_ticket(rows=1, at=clock()))
+    assert b.ready()                  # occupancy trigger, zero wait
+    assert [t.rows for t in b.take()] == [1, 1, 1, 1]
+    assert len(b) == 1                # the 5th waits for the next flush
+
+
+def test_batches_never_mix_models():
+    b = MicroBatcher(max_batch=8, max_wait_s=0.0, clock=_ticker())
+    b.offer(_ticket(model="a", rows=2))
+    b.offer(_ticket(model="a", rows=1))
+    b.offer(_ticket(model="b", rows=1))
+    b.offer(_ticket(model="a", rows=1))
+    assert [t.model for t in b.take()] == ["a", "a"]   # stops at b
+    assert [t.model for t in b.take()] == ["b"]        # FIFO preserved
+    assert [t.model for t in b.take()] == ["a"]
+
+
+def test_bucket_helpers():
+    assert default_buckets(64) == (1, 8, 32, 64)
+    assert default_buckets(1) == (1,)
+    assert bucket_for(1, (1, 8, 64)) == 1
+    assert bucket_for(9, (1, 8, 64)) == 64
+    with pytest.raises(ValueError):
+        bucket_for(65, (1, 8, 64))
+    assert parse_buckets("1, 8, 64", 64) == (1, 8, 64)
+    assert parse_buckets("", 16) == default_buckets(16)
+    with pytest.raises(ValueError):
+        parse_buckets("1,8", 64)      # largest bucket < max_batch
+    with pytest.raises(ValueError):
+        parse_buckets("0,8,64", 64)
+
+
+# -- end-to-end: concurrent submits bit-identical to direct scoring ----------
+
+def test_concurrent_submits_bit_identical_to_transform():
+    import threading
+    m = make_model()
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(24, 8)).astype(np.float32)
+    direct = np.asarray(m.transform(Frame.from_dict({"x": X})).column("y"))
+
+    with Server({"mlp": m}, max_batch=8, max_wait_ms=2.0,
+                queue_depth=64) as srv:
+        results = [None] * 4
+        def client(c):
+            rows = list(range(c, 24, 4))
+            futs = [(i, srv.submit_async("mlp", X[i])) for i in rows]
+            results[c] = [(i, f.result(30)) for i, f in futs]
+        threads = [threading.Thread(target=client, args=(c,), daemon=True)
+                   for c in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        got = np.zeros_like(direct)
+        for chunk in results:
+            for i, y in chunk:
+                got[i] = y
+        # bit-identical, not allclose: batching/padding must not perturb
+        # a single ulp vs offline transform
+        assert np.array_equal(got, direct)
+        # submit_many reassembles rows in order through the same path
+        assert np.array_equal(srv.submit_many("mlp", X, timeout=30), direct)
+
+
+def test_single_row_1d_input_and_multi_model():
+    ma, mb = make_model(seed=0), make_model(seed=1)
+    x = np.arange(8, dtype=np.float32)
+    with Server({"a": ma, "b": mb}, max_batch=4, max_wait_ms=1.0) as srv:
+        ya = srv.submit("a", x, timeout=30)
+        yb = srv.submit("b", x, timeout=30)
+        assert ya.shape == (1, 3) and yb.shape == (1, 3)
+        assert not np.array_equal(ya, yb)    # different params served
+        with pytest.raises(KeyError):
+            srv.submit_async("nope", x)
+
+
+# -- admission control -------------------------------------------------------
+
+def test_overload_sheds_immediately():
+    srv = Server({"mlp": make_model()}, max_batch=4, max_wait_ms=1.0,
+                 queue_depth=2, start=False)     # nothing drains the queue
+    x = np.zeros(8, np.float32)
+    f1, f2 = srv.submit_async("mlp", x), srv.submit_async("mlp", x)
+    with pytest.raises(ServerOverloaded):
+        srv.submit_async("mlp", x)
+    assert srv.stats()["shed"] == 1
+    assert srv.stats()["admitted"] == 2
+    srv.close(drain=False)                        # fail, don't score
+    for f in (f1, f2):
+        with pytest.raises(ServerClosed):
+            f.result(0)
+    with pytest.raises(ServerClosed):
+        srv.submit_async("mlp", x)
+
+
+def test_overloaded_is_retryable_by_default_policy():
+    from mmlspark_tpu.reliability.retry import RetryPolicy, default_retryable
+    assert default_retryable(ServerOverloaded("full"))
+    assert not default_retryable(RequestExpired("late"))
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ServerOverloaded("queue full")
+        return "ok"
+    policy = RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0,
+                         sleep=lambda s: None)
+    assert policy.call(flaky) == "ok"
+    assert calls["n"] == 3
+
+
+# -- deadlines ---------------------------------------------------------------
+
+def test_expired_requests_cancelled_not_computed(monkeypatch):
+    clock = _ticker()
+    srv = Server({"mlp": make_model()}, max_batch=4, max_wait_ms=1.0,
+                 clock=clock, start=False)
+    scored = []
+    orig = registry_mod.ModelEntry.score
+    monkeypatch.setattr(registry_mod.ModelEntry, "score",
+                        lambda self, x: scored.append(x.shape) or
+                        orig(self, x))
+    x = np.zeros(8, np.float32)
+    late = srv.submit_async("mlp", x, deadline_ms=50.0)
+    ok = srv.submit_async("mlp", x)               # no deadline
+    clock.advance(0.2)                            # 200ms > 50ms deadline
+    srv.close(drain=True)                         # dequeues + flushes
+    with pytest.raises(RequestExpired):
+        late.result(0)
+    assert ok.result(0).shape == (1, 3)           # live ticket still scored
+    assert srv.stats()["expired"] == 1
+    # the expired ticket's row was dropped BEFORE padding/scoring: one
+    # 1-row batch padded to the 1-bucket, never a 2-row group
+    assert scored == [(1, 8)]
+
+
+def test_default_deadline_from_config():
+    clock = _ticker()
+    config.set("serving.default_deadline_ms", 10.0)
+    try:
+        srv = Server({"mlp": make_model()}, max_batch=4, clock=clock,
+                     start=False)
+        f = srv.submit_async("mlp", np.zeros(8, np.float32))
+        clock.advance(1.0)
+        srv.close(drain=True)
+        with pytest.raises(RequestExpired):
+            f.result(0)
+    finally:
+        config.unset("serving.default_deadline_ms")
+
+
+# -- compile discipline ------------------------------------------------------
+
+def test_at_most_one_compile_per_bucket(monkeypatch):
+    compiled = []
+    orig = registry_mod.ModelEntry._compile
+
+    def spy(self, bucket, row_shape, dtype):
+        compiled.append(bucket)
+        return orig(self, bucket, row_shape, dtype)
+    monkeypatch.setattr(registry_mod.ModelEntry, "_compile", spy)
+
+    m = make_model()
+    rng = np.random.default_rng(1)
+    with Server({"mlp": m}, max_batch=8, max_wait_ms=1.0,
+                buckets=(1, 4, 8)) as srv:
+        # 30 requests of varying sizes, far more requests than buckets
+        for rows in [1, 3, 2, 1, 4, 8, 5, 1, 7, 2] * 3:
+            y = srv.submit("mlp", rng.normal(size=(rows, 8)), timeout=30)
+            assert y.shape == (rows, 3)
+    assert set(compiled) <= {1, 4, 8}
+    assert len(compiled) == len(set(compiled)), \
+        f"re-compiled a bucket: {compiled}"
+
+
+def test_registry_lru_eviction_under_budget():
+    from mmlspark_tpu.serve.registry import ModelRegistry
+    ma, mb = make_model(seed=0), make_model(seed=1)
+    reg = ModelRegistry(budget_mb=1e-9)           # fits nothing twice
+    ea, eb = reg.add("a", ma), reg.add("b", mb)
+    ea.ensure_apply()
+    reg.touch(ea)
+    assert ea.warm                                # sole over-budget model
+    eb.ensure_apply()
+    reg.touch(eb)                                 # b is MRU; a must go
+    assert eb.warm and not ea.warm
+    assert reg.stats()["evictions"] == 1
+    assert ma._jit_cache is None                  # params unpinned
+    ea.ensure_apply()                             # re-warm works
+    assert ea.warm
+
+
+# -- fault injection ---------------------------------------------------------
+
+def test_fault_site_score_fails_batch_not_server():
+    from mmlspark_tpu.reliability.faults import (
+        FaultPlan, FaultSpec, InjectedFault,
+    )
+    with Server({"mlp": make_model()}, max_batch=4, max_wait_ms=1.0) as srv:
+        x = np.zeros(8, np.float32)
+        with FaultPlan(FaultSpec("serve.score", on_hit=1)):
+            with pytest.raises(InjectedFault):
+                srv.submit("mlp", x, timeout=30)
+        # the executor survived the injected batch failure
+        assert srv.submit("mlp", x, timeout=30).shape == (1, 3)
+
+
+def test_fault_site_enqueue_rejects_before_admission():
+    from mmlspark_tpu.reliability.faults import (
+        FaultPlan, FaultSpec, InjectedFault,
+    )
+    srv = Server({"mlp": make_model()}, start=False)
+    with FaultPlan(FaultSpec("serve.enqueue", on_hit=1)):
+        with pytest.raises(InjectedFault):
+            srv.submit_async("mlp", np.zeros(8, np.float32))
+    assert srv.stats()["admitted"] == 0
+    srv.close(drain=False)
+
+
+# -- telemetry + report ------------------------------------------------------
+
+def test_report_renders_serving_section(tmp_path, capsys):
+    path = tmp_path / "events.jsonl"
+    config.set("observability.events_path", str(path))
+    try:
+        # completed requests through a live server
+        with Server({"mlp": make_model()}, max_batch=4,
+                    max_wait_ms=1.0) as srv:
+            X = np.random.default_rng(0).normal(size=(6, 8))
+            srv.submit_many("mlp", X, timeout=30)
+        # one shed (bounded queue, no executor) + one expired (fake clock)
+        srv2 = Server({"mlp": make_model()}, queue_depth=1, start=False)
+        srv2.submit_async("mlp", np.zeros(8, np.float32))
+        with pytest.raises(ServerOverloaded):
+            srv2.submit_async("mlp", np.zeros(8, np.float32))
+        srv2.close(drain=True)
+        clock = _ticker()
+        srv3 = Server({"mlp": make_model()}, clock=clock, start=False)
+        f = srv3.submit_async("mlp", np.zeros(8, np.float32),
+                              deadline_ms=1.0)
+        clock.advance(1.0)
+        srv3.close(drain=True)
+        with pytest.raises(RequestExpired):
+            f.result(0)
+    finally:
+        events.close()
+        config.unset("observability.events_path")
+
+    lines = [json.loads(ln) for ln in
+             path.read_text().splitlines() if ln.strip()]
+    reqs = [e for e in lines
+            if e["type"] == "serving" and e["name"] == "request"]
+    # submit_many(6 rows, max_batch=4) -> 2 tickets, + srv2's drained one
+    assert len(reqs) >= 3
+    assert {"queue_ms", "pad_ms", "compute_ms", "total_ms",
+            "bucket", "occupancy"} <= set(reqs[0])
+
+    from mmlspark_tpu.cli import main
+    assert main(["report", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "serving:" in out
+    assert "p50=" in out and "p99=" in out
+    assert "shed: 1" in out
+    assert "expired: 1" in out
+
+
+def test_metrics_counters_and_hot_instruments():
+    config.set("observability.metrics", True)
+    try:
+        with Server({"mlp": make_model()}, max_batch=4,
+                    max_wait_ms=1.0) as srv:
+            srv.submit("mlp", np.zeros(8, np.float32), timeout=30)
+        dump = metrics.get_registry().to_dict()
+        assert dump["serving.admitted"]["value"] == 1
+        assert dump["serving.completed"]["value"] == 1
+        assert dump["serving.total_ms"]["count"] == 1
+        assert dump["serving.compute_ms"]["count"] == 1
+        assert 0.0 < dump["serving.batch_occupancy"]["value"] <= 1.0
+    finally:
+        config.unset("observability.metrics")
+
+
+# -- HTTP front-end ----------------------------------------------------------
+
+def test_http_roundtrip_and_error_mapping(tmp_path):
+    import threading
+    import urllib.error
+    import urllib.request
+    from mmlspark_tpu.serve.http import serve_http
+
+    m = make_model()
+    x = [[0.0] * 8]
+    direct = None
+    with Server({"mlp": m}, max_batch=4, max_wait_ms=1.0) as srv:
+        direct = srv.submit("mlp", np.asarray(x, np.float32), timeout=30)
+        httpd, addr = serve_http(srv, port=0)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            def post(payload, path="/score"):
+                req = urllib.request.Request(
+                    f"http://{addr}{path}",
+                    data=json.dumps(payload).encode())
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return json.loads(r.read())
+
+            got = post({"model": "mlp", "x": x})
+            assert np.array_equal(np.asarray(got["y"], np.float32), direct)
+
+            with urllib.request.urlopen(f"http://{addr}/healthz",
+                                        timeout=30) as r:
+                health = json.loads(r.read())
+            assert health["status"] == "ok"
+            assert health["stats"]["completed"] >= 2
+
+            with urllib.request.urlopen(f"http://{addr}/models",
+                                        timeout=30) as r:
+                assert json.loads(r.read())["models"] == ["mlp"]
+
+            with urllib.request.urlopen(f"http://{addr}/metrics",
+                                        timeout=30) as r:
+                assert "serving_admitted" in r.read().decode()
+
+            for bad, code in [({"model": "nope", "x": x}, 400),
+                              ({"x": x}, 400)]:
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    post(bad)
+                assert ei.value.code == code
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post({"model": "mlp", "x": x}, path="/nope")
+            assert ei.value.code == 404
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+def test_http_maps_overload_to_503():
+    import threading
+    import urllib.error
+    import urllib.request
+    from mmlspark_tpu.serve.http import serve_http
+
+    # no executor + depth 1 already holding a ticket: the next HTTP
+    # score is shed synchronously, which must surface as a retryable 503
+    srv = Server({"mlp": make_model()}, queue_depth=1, start=False)
+    srv.submit_async("mlp", np.zeros(8, np.float32))
+    httpd, addr = serve_http(srv, port=0)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        req = urllib.request.Request(
+            f"http://{addr}/score",
+            data=json.dumps({"model": "mlp", "x": [[0.0] * 8]}).encode())
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+        assert ei.value.headers["Retry-After"] == "0"
+        assert json.loads(ei.value.read())["retryable"] is True
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        srv.close(drain=False)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_cli_model_flag_parsing():
+    from mmlspark_tpu.cli import _parse_model_flag
+    name, arch, kw = _parse_model_flag(
+        'mlp=mlp_tabular:{"input_dim": 8, "hidden": [16]}')
+    assert (name, arch) == ("mlp", "mlp_tabular")
+    assert kw == {"input_dim": 8, "hidden": [16]}
+    assert _parse_model_flag("m=arch") == ("m", "arch", {})
+    for bad in ["noequals", "name=", "=arch", "m=arch:{not json"]:
+        with pytest.raises(SystemExit):
+            _parse_model_flag(bad)
+
+
+def test_cli_serve_requires_model():
+    from mmlspark_tpu.cli import main
+    with pytest.raises(SystemExit):
+        main(["serve"])
