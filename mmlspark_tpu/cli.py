@@ -255,8 +255,8 @@ def cmd_info(args, passthrough) -> int:
 
 def cmd_check(args, passthrough) -> int:
     """Static reliability lint (urlopen-without-timeout, swallowed
-    excepts, print-in-library-code) over the installed package, or
-    explicit roots."""
+    excepts, print-in-library-code, implicit-daemon threads, unbounded
+    queues) over the installed package, or explicit roots."""
     from mmlspark_tpu.reliability import lint
     roots = args.roots or [os.path.dirname(
         os.path.abspath(__import__("mmlspark_tpu").__file__))]
@@ -403,7 +403,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     bench_p.set_defaults(fn=cmd_bench)
 
     check_p = sub.add_parser(
-        "check", help="static reliability lint (timeouts, swallowed excepts)")
+        "check", help="static reliability lint (timeouts, swallowed "
+                      "excepts, unbounded queues)")
     check_p.add_argument("roots", nargs="*",
                          help="files/dirs to lint (default: the installed "
                          "mmlspark_tpu package)")

@@ -1,0 +1,18 @@
+"""Streaming input pipeline: sharded, shuffled, resumable datasets.
+
+``FileSource -> ShuffleBuffer -> ParallelDecode -> Batcher -> device``,
+every stage checkpointable (``state_dict``/``load_state_dict``) so
+training resumes mid-epoch bit-identically. See docs/DATA.md.
+"""
+from mmlspark_tpu.data.pipeline import (  # noqa: F401
+    Batcher,
+    Dataset,
+    FileSource,
+    MapRecords,
+    ParallelDecode,
+    PipelineIterator,
+    Repeat,
+    ShuffleBuffer,
+    default_decode,
+)
+from mmlspark_tpu.data.prefetch import DevicePrefetcher  # noqa: F401

@@ -29,6 +29,7 @@ import numpy as np
 from mmlspark_tpu.core.frame import Frame
 from mmlspark_tpu.core.schema import ColumnSchema, DType, ImageValue, Schema
 from mmlspark_tpu.io.codecs import decode_image
+from mmlspark_tpu.observability import metrics as obsmetrics
 from mmlspark_tpu.reliability.faults import fault_site
 
 
@@ -68,6 +69,45 @@ def _process_slice(items: List, process_shard: bool) -> List:
     return items[bounds[i]:bounds[i + 1]]
 
 
+def list_binary_entries(path: str, recursive: bool = False,
+                        sample_ratio: float = 1.0, inspect_zip: bool = True,
+                        seed: int = 0,
+                        process_shard: bool = False
+                        ) -> List[Tuple[str, Optional[str]]]:
+    """The deterministic entry LISTING under every binary reader: a list of
+    ``(file_path, zip_entry_name_or_None)`` after the recursive walk, the
+    seeded fractional sample, the zip-entry expansion, and the per-process
+    contiguous slice. Pure metadata — no payload is read — so it doubles
+    as the shard/cursor space for the streaming pipeline's ``FileSource``
+    (``data/pipeline.py``): entry ``i`` here is record ``i`` there, and in
+    ``iter_binary_entries``/``read_binary_files``.
+    """
+    if not 0.0 < sample_ratio <= 1.0:
+        raise ValueError(f"sample_ratio must be in (0, 1], got {sample_ratio}")
+    all_files = _list_files(path, recursive)
+    # Zips are exempt from file-level sampling when inspected — their ENTRIES
+    # are sampled instead (reference SamplePathFilter, HadoopUtils.scala:104:
+    # `isZipFile(path) && inspectZip || random < sampleRatio`).
+    zips = {f for f in all_files
+            if inspect_zip and f.endswith(".zip") and zipfile.is_zipfile(f)}
+    files = _process_slice(
+        sorted(_sample([f for f in all_files if f not in zips],
+                       sample_ratio, seed) + list(zips)), process_shard)
+    entries: List[Tuple[str, Optional[str]]] = []
+    for f in files:
+        if f in zips:
+            with zipfile.ZipFile(f) as z:
+                names = [n for n in sorted(z.namelist())
+                         if not n.endswith("/")]
+                # zip entries are themselves subject to the sample ratio
+                # (reference ZipIterator seeded sampling)
+                entries.extend((f, n) for n in _sample(names, sample_ratio,
+                                                       seed))
+        else:
+            entries.append((f, None))
+    return entries
+
+
 def iter_binary_entries(path: str, recursive: bool = False,
                         sample_ratio: float = 1.0, inspect_zip: bool = True,
                         seed: int = 0, process_shard: bool = False):
@@ -82,30 +122,25 @@ def iter_binary_entries(path: str, recursive: bool = False,
     the sorted file list (a zip counts as one file; its entries stay
     together) — per-host ingestion for multi-process training.
     """
-    if not 0.0 < sample_ratio <= 1.0:
-        raise ValueError(f"sample_ratio must be in (0, 1], got {sample_ratio}")
-    all_files = _list_files(path, recursive)
-    # Zips are exempt from file-level sampling when inspected — their ENTRIES
-    # are sampled instead (reference SamplePathFilter, HadoopUtils.scala:104:
-    # `isZipFile(path) && inspectZip || random < sampleRatio`).
-    zips = {f for f in all_files
-            if inspect_zip and f.endswith(".zip") and zipfile.is_zipfile(f)}
-    files = _process_slice(
-        sorted(_sample([f for f in all_files if f not in zips],
-                       sample_ratio, seed) + list(zips)), process_shard)
-    for f in files:
-        if f in zips:
-            with zipfile.ZipFile(f) as z:
-                names = [n for n in sorted(z.namelist())
-                         if not n.endswith("/")]
-                # zip entries are themselves subject to the sample ratio
-                # (reference ZipIterator seeded sampling)
-                for n in _sample(names, sample_ratio, seed):
-                    yield f"{f}/{n}", fault_site("readers.read",
-                                                 payload=z.read(n))
-        else:
-            with open(f, "rb") as fh:
-                yield f, fault_site("readers.read", payload=fh.read())
+    entries = list_binary_entries(path, recursive, sample_ratio, inspect_zip,
+                                  seed, process_shard)
+    zf_path: Optional[str] = None
+    zf: Optional[zipfile.ZipFile] = None
+    try:
+        for f, inner in entries:
+            if inner is None:
+                with open(f, "rb") as fh:
+                    yield f, fault_site("readers.read", payload=fh.read())
+            else:
+                if zf_path != f:  # entries of one zip are contiguous
+                    if zf is not None:
+                        zf.close()
+                    zf_path, zf = f, zipfile.ZipFile(f)
+                yield f"{f}/{inner}", fault_site("readers.read",
+                                                 payload=zf.read(inner))
+    finally:
+        if zf is not None:
+            zf.close()
 
 
 def stream_binary_files(path: str, recursive: bool = False,
@@ -150,6 +185,9 @@ def stream_images(path: str, recursive: bool = False,
             if arr is not None:
                 images.append(ImageValue(path=pth, data=arr))
                 keep.append(pth)
+        if len(images) < len(decoded):
+            obsmetrics.counter("data.decode_dropped").inc(
+                len(decoded) - len(images))
         if images:
             yield {"path": _object_array(keep), "image": _object_array(images)}
 
@@ -220,6 +258,10 @@ def read_images(path: str, recursive: bool = False, sample_ratio: float = 1.0,
             keep_paths.append(pth)
         parts.append({"path": _object_array(keep_paths),
                       "image": _object_array(images)})
+    if dropped:
+        # drops are rare by construction: unconditional cold counter, so
+        # the loss shows in run reports even with hot-path metrics off
+        obsmetrics.counter("data.decode_dropped").inc(dropped)
     schema = Schema([
         ColumnSchema("path", DType.STRING),
         ColumnSchema("image", DType.IMAGE,

@@ -13,7 +13,9 @@ The offline half of the telemetry loop (``mmlspark-tpu report
   MetricLogger emit (steps, rows, examples/sec), plus any bench results;
 - serving: per-request SLO breakdown from the serve subsystem's
   ``serving.request`` events (p50/p99 total latency, mean queue/pad/compute
-  split, batch occupancy) plus shed/expired counts and the shed rate.
+  split, batch occupancy) plus shed/expired counts and the shed rate;
+- input pipeline: per-epoch item counts and wall time from the streaming
+  ``data.epoch`` events (data/pipeline.py's ``Repeat`` stage).
 
 Pure text in, text out — no jax, no framework state — so it runs anywhere
 the log file can be copied to.
@@ -182,6 +184,18 @@ def render_report(path: str, top: int = 10) -> str:
                 f"  train.step: {len(step_metrics)} logged steps, last "
                 f"step {last.get('step', '?')}, examples/sec last="
                 f"{rates[-1]:.1f} max={max(rates):.1f}")
+        out.append("")
+
+    # -- input pipeline ------------------------------------------------------
+    epochs = [e for e in plain if e.get("name") == "data.epoch"]
+    if epochs:
+        out.append("input pipeline:")
+        for e in epochs:
+            wall = float(e.get("wall_s", 0.0))
+            items = int(e.get("items", 0))
+            rate = items / wall if wall > 0 else 0.0
+            out.append(f"  epoch {e.get('epoch', '?')}: {items} items in "
+                       f"{wall:.3f}s ({rate:.1f} items/sec)")
         out.append("")
 
     # -- bench results -------------------------------------------------------

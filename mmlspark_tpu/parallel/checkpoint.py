@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import shutil
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -120,6 +121,56 @@ class TrainCheckpointer:
                 return json.load(f)
         except FileNotFoundError:
             return {}
+
+    # -- input-pipeline state ------------------------------------------------
+    # The streaming pipeline's iterator snapshot (data/pipeline.py
+    # state_dict: file cursor, shuffle block, batch boundary) saves NEXT TO
+    # each checkpoint step so ResilientTrainLoop.run_dataset resumes the
+    # batch stream mid-epoch bit-identically. Unlike the run-metadata
+    # sidecar above, this is PER PROCESS — each host's shard cursor
+    # differs — and per step, so a quarantined step falls back to the
+    # older step's matching snapshot. Same atomic tmp+replace discipline.
+    _DATA_STATE_RE = re.compile(r"data_state-(\d+)\.p\d+\.json$")
+
+    def _data_state_path(self, step: int) -> str:
+        return os.path.join(
+            self.directory,
+            f"data_state-{step}.p{jax.process_index()}.json")
+
+    def put_data_state(self, step: int, state: Dict[str, Any]) -> str:
+        """Persist an input-pipeline ``state_dict`` for ``step`` (call it
+        just BEFORE ``save(step)``: an orphan snapshot for an uncommitted
+        step is harmless, a committed step without its snapshot loses
+        mid-epoch resume). Returns the written path."""
+        path = self._data_state_path(step)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, path)
+        self._gc_data_state(keep_step=step)
+        return path
+
+    def get_data_state(self, step: int) -> Optional[Dict[str, Any]]:
+        """This process's pipeline snapshot for ``step``, or None when the
+        checkpoint predates the streaming pipeline (params-only resume)."""
+        try:
+            with open(self._data_state_path(step)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    def _gc_data_state(self, keep_step: int) -> None:
+        """Drop snapshots for steps orbax has pruned (max_to_keep); the
+        step being written now may not be committed yet, so it is always
+        kept explicitly."""
+        keep = set(self.all_steps()) | {keep_step}
+        for name in os.listdir(self.directory):
+            m = self._DATA_STATE_RE.match(name)
+            if m and int(m.group(1)) not in keep:
+                try:
+                    os.remove(os.path.join(self.directory, name))
+                except OSError as e:  # another process may GC concurrently
+                    _LOG.debug("data-state GC skipped %s (%s)", name, e)
 
     # -- read ---------------------------------------------------------------
     def latest_step(self) -> Optional[int]:

@@ -16,10 +16,8 @@ via the same mesh.
 """
 from __future__ import annotations
 
-import queue
-import threading
 from functools import partial
-from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +25,11 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# DevicePrefetcher moved to data/prefetch.py (the streaming input pipeline's
+# terminal stage); re-exported here because trainer.DevicePrefetcher is the
+# documented import path for existing callers (train/deep.py, tests).
+from mmlspark_tpu.data.pipeline import Dataset
+from mmlspark_tpu.data.prefetch import DevicePrefetcher  # noqa: F401
 from mmlspark_tpu.parallel.mesh import mesh_from_config
 from mmlspark_tpu.observability import events as obsevents
 from mmlspark_tpu.observability import metrics as obsmetrics
@@ -39,95 +42,6 @@ from mmlspark_tpu.utils import config as mmlconfig
 from mmlspark_tpu.utils.logging import MetricLogger, get_logger
 
 LossFn = Callable[[Any, Dict[str, jax.Array], jax.Array], jax.Array]
-
-
-class DevicePrefetcher:
-    """Double-buffered host->HBM prefetch (SURVEY.md §7 "streaming host→HBM
-    without stalls").
-
-    A background thread pulls host batches — the expensive host work: epoch
-    shuffling, tail padding, feature assembly — and queues them ``depth``
-    deep. The consuming ``next()`` commits each batch's ``device_put`` on the
-    caller's thread and returns immediately: JAX dispatch is asynchronous, so
-    the transfer overlaps the still-running previous step and the Python loop
-    stays ahead of the device. All JAX runtime calls therefore happen on ONE
-    thread — issuing ``device_put`` from the producer thread concurrently
-    with a jitted execution aborts flakily inside the multi-device CPU
-    runtime (XLA client race), and single-threaded dispatch loses nothing
-    because the runtime pipelines the async transfers anyway.
-    Exceptions in the producer re-raise at the consuming ``next()``.
-    """
-
-    _SENTINEL = object()
-
-    def __init__(self, host_batches: Iterable[Dict[str, np.ndarray]],
-                 put: Callable[[Dict[str, np.ndarray]], Any],
-                 depth: Optional[int] = None):
-        self.depth = depth if depth is not None else int(
-            mmlconfig.get("runtime.prefetch_depth"))
-        self._put = put
-        self._q: queue.Queue = queue.Queue(maxsize=max(self.depth, 1))
-        self._err: Optional[BaseException] = None
-        self._stop = threading.Event()
-        self._done = False
-
-        def run():
-            try:
-                for hb in host_batches:
-                    if self._stop.is_set():
-                        return
-                    # bounded put that notices close(): never blocks forever
-                    while not self._stop.is_set():
-                        try:
-                            self._q.put(hb, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-            except BaseException as e:  # surfaced on the consumer side
-                self._err = e
-            finally:
-                # bounded sentinel put: a full queue must not lose the
-                # end-of-stream marker, but close() must still unblock us
-                while not self._stop.is_set():
-                    try:
-                        self._q.put(self._SENTINEL, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-
-        self._thread = threading.Thread(target=run, daemon=True,
-                                        name="mmlspark-tpu-prefetch")
-        self._thread.start()
-
-    def close(self) -> None:
-        """Stop the producer and drop queued host batches. Call from a
-        ``finally`` when abandoning the stream early."""
-        self._stop.set()
-        # join FIRST (the producer's bounded put notices _stop within 0.1s),
-        # then drain — draining before the join can free a slot that the
-        # producer immediately refills, keeping a batch buffered
-        self._thread.join(timeout=5)
-        while True:
-            try:
-                self._q.get_nowait()
-            except queue.Empty:
-                break
-        self._done = True
-
-    def __iter__(self) -> Iterator[Any]:
-        return self
-
-    def __next__(self) -> Any:
-        if self._done:
-            raise StopIteration
-        item = self._q.get()
-        if item is self._SENTINEL:
-            self._done = True
-            self._thread.join()
-            if self._err is not None:
-                raise self._err
-            raise StopIteration
-        return self._put(item)
 
 
 _SPLIT_JIT = None
@@ -512,6 +426,11 @@ class DistributedTrainer:
             collect_losses: bool = True) -> Tuple[Any, list]:
         """Drive an epoch of host batches through the sharded step.
 
+        ``batches`` is any iterable of host-batch dicts — a list, a
+        generator, or a streaming ``mmlspark_tpu.data.Dataset`` (its
+        iterator is built here; pass the Dataset itself, not ``.iter()``,
+        unless mid-epoch state must be owned by the caller).
+
         Host->HBM transfer is double-buffered: a DevicePrefetcher thread
         assembles host batches ahead of the loop, and each ``device_put``
         dispatches asynchronously on this thread so the transfer overlaps
@@ -523,6 +442,8 @@ class DistributedTrainer:
         transfer at the end) and returns an empty list.
         """
         rng = rng if rng is not None else jax.random.PRNGKey(0)
+        if isinstance(batches, Dataset):
+            batches = batches.iter()
         losses = []
         metric_log = (MetricLogger(every=log_every)
                       if log_every and log_fn is None else None)
@@ -561,6 +482,9 @@ class DistributedTrainer:
                                batch_rows=rows)
         finally:
             prefetcher.close()  # stops the producer if we exited early
+            closer = getattr(batches, "close", None)
+            if callable(closer):  # pipeline iterators own decode pools
+                closer()
         if telemetry and steps:
             # one sync per EPOCH (the exit paths below all wait on the last
             # loss anyway) so throughput covers completed device work, not

@@ -17,6 +17,9 @@ Instrumented sites (grep for ``fault_site(`` to confirm the live list):
 - ``checkpoint.save.commit`` — after dispatch, before the commit wait
 - ``checkpoint.restore``  — before the orbax restore
 - ``readers.read``        — carries each binary file/zip-entry payload
+- ``data.list``           — before the input pipeline lists/shards files
+- ``data.shuffle``        — before each shuffle window permutes
+- ``data.decode``         — before each record enters the decode pool
 - ``trainer.train_step``  — before each sharded train step
 - ``serve.enqueue``       — before a request enters the admission queue
 - ``serve.batch``         — after a micro-batch is dequeued, pre-padding

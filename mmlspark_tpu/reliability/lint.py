@@ -22,6 +22,13 @@ shutdown BLOCKS on the thread, and an implicit choice is how a serving
 executor or prefetch worker quietly turns Ctrl-C into a hang. Every
 library-code thread states its shutdown contract at the constructor.
 
+Rule 5 — ``queue.Queue(...)`` without an explicit ``maxsize=``: the
+default is unbounded, which silently removes backpressure — a stalled
+consumer (a wedged device, a slow decode stage) lets the producer buffer
+the whole stream in host memory instead of blocking. Every library-code
+queue states its bound; a deliberate unbounded queue writes ``maxsize=0``
+so the choice is greppable.
+
 Shared core for ``tools/check_reliability.py`` (standalone CLI),
 ``mmlspark-tpu check`` (installed CLI), and the in-pytest gate
 (tests/test_reliability_lint.py) — same single source of truth pattern as
@@ -48,6 +55,12 @@ def _is_thread_ctor(call: ast.Call) -> bool:
     f = call.func
     return (isinstance(f, ast.Name) and f.id == "Thread") or \
         (isinstance(f, ast.Attribute) and f.attr == "Thread")
+
+
+def _is_queue_ctor(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Name) and f.id == "Queue") or \
+        (isinstance(f, ast.Attribute) and f.attr == "Queue")
 
 
 def _catches_everything(node: ast.expr) -> bool:
@@ -89,6 +102,17 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
                     f"{filename}:{node.lineno}: Thread() without explicit "
                     "daemon= (state the shutdown contract; an inherited "
                     "flag hangs or kills by accident)")
+        elif isinstance(node, ast.Call) and _is_queue_ctor(node):
+            has_maxsize = any(kw.arg == "maxsize" for kw in node.keywords)
+            has_star_kwargs = any(kw.arg is None for kw in node.keywords)
+            # positional signature is Queue(maxsize=0): a first positional
+            # arg IS the maxsize
+            has_positional = len(node.args) >= 1
+            if not (has_maxsize or has_star_kwargs or has_positional):
+                problems.append(
+                    f"{filename}:{node.lineno}: Queue() without explicit "
+                    "maxsize= (unbounded queues hide backpressure; state "
+                    "the bound, or maxsize=0 to make unbounded deliberate)")
         elif isinstance(node, ast.Call) and _is_urlopen(node):
             has_timeout = any(kw.arg == "timeout" for kw in node.keywords)
             has_star_kwargs = any(kw.arg is None for kw in node.keywords)

@@ -106,3 +106,58 @@ class ResilientTrainLoop:
         if self.ckpt.latest_step() != total_steps:
             self.ckpt.save(state, step=total_steps, wait=True)
         return state
+
+    def run_dataset(self, data, total_steps: int,
+                    rng: Optional[Any] = None) -> Any:
+        """Crash-safe training over a streaming input pipeline.
+
+        ``data`` is a ``mmlspark_tpu.data.Dataset`` (typically ending in
+        ``.batch(...).repeat(...)``) or an already-built
+        ``PipelineIterator``. The pipeline's ``state_dict`` persists with
+        EVERY checkpoint (``TrainCheckpointer.put_data_state``), so a
+        restart restores both the params and the input cursor and the
+        resumed run replays the interrupted batch stream mid-epoch,
+        bit-for-bit — the streaming-side extension of ``run``'s
+        deterministic ``batch_fn(step)`` contract. The snapshot writes
+        BEFORE the (async) checkpoint save: an orphan snapshot is
+        harmless, a committed step without one would restart the stream.
+        """
+        import jax
+        from mmlspark_tpu.data.pipeline import Dataset
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        state, start = self.restore_or_init()
+        if start > 0:
+            _LOG.info("resuming from checkpoint step %d", start)
+        it = data.iter() if isinstance(data, Dataset) else data
+        try:
+            if start > 0:
+                snapshot = self.ckpt.get_data_state(start)
+                if snapshot is not None:
+                    it.load_state_dict(snapshot)
+                else:
+                    _LOG.warning(
+                        "checkpoint step %d has no input-pipeline snapshot; "
+                        "the stream restarts from its beginning", start)
+            if start >= total_steps:
+                return state
+            for step in range(start + 1, total_steps + 1):
+                try:
+                    host = next(it)
+                except StopIteration:
+                    raise ValueError(
+                        f"dataset exhausted at step {step} of {total_steps};"
+                        " add .repeat() for multi-epoch runs") from None
+                batch = self.trainer.put_batch(host)
+                state, _metrics = self.trainer.train_step(state, batch, rng)
+                if self.save_every > 0 and step % self.save_every == 0:
+                    self.ckpt.put_data_state(step, it.state_dict())
+                    self.ckpt.save(state, step=step)
+            self.ckpt.wait()
+            if self.ckpt.latest_step() != total_steps:
+                self.ckpt.put_data_state(total_steps, it.state_dict())
+                self.ckpt.save(state, step=total_steps, wait=True)
+            return state
+        finally:
+            closer = getattr(it, "close", None)
+            if callable(closer):
+                closer()

@@ -23,6 +23,13 @@ _DEFAULTS: Dict[str, Any] = {
     "runtime.decode_threads": 0,      # 0 = native codec picks (ncpu)
     "runtime.mesh": "",               # launcher default, e.g. "data=-1,tensor=2"
     "runtime.device_cache_mb": 1024,  # HBM budget for device-resident epochs
+    # data (streaming input pipeline; data/ package — see docs/DATA.md).
+    # Values are validated at stage construction: window/workers must be
+    # >= 1, prefetch_depth >= 0.
+    "data.shuffle_window": 1024,   # records per windowed-shuffle block
+    "data.decode_workers": 4,      # parallel decode worker threads
+    "data.prefetch_depth": 0,      # to_device_iterator queue depth
+                                   # (0 = inherit runtime.prefetch_depth)
     # evaluation: rows above which evaluators run as jitted XLA programs
     # instead of driver numpy. The device path wins when chips are
     # locally attached (the scored column crosses PCIe once instead of

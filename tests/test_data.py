@@ -1,0 +1,489 @@
+"""Streaming input pipeline (mmlspark_tpu.data): equivalence with the
+materialized-Frame readers, seeded shuffle determinism, mid-epoch
+crash/resume bit-identity (pipeline-level and through
+ResilientTrainLoop + TrainCheckpointer), off-consumer-thread decode,
+batching policies, and the device-prefetch terminal stage."""
+import json
+import sys
+import threading
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from mmlspark_tpu.data import (Batcher, Dataset, FileSource, ParallelDecode,
+                               PipelineIterator, ShuffleBuffer)
+from mmlspark_tpu.data.pipeline import _stack_records
+from mmlspark_tpu.io.codecs import encode_bmp
+from mmlspark_tpu.io.readers import read_images
+from mmlspark_tpu.observability import events as obsevents
+from mmlspark_tpu.observability import metrics as obsmetrics
+from mmlspark_tpu.parallel.checkpoint import TrainCheckpointer
+from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+from mmlspark_tpu.parallel.trainer import DistributedTrainer
+from mmlspark_tpu.reliability.faults import FaultPlan, FaultSpec, InjectedFault
+from mmlspark_tpu.reliability.resilient import ResilientTrainLoop
+from mmlspark_tpu.utils import config
+
+DIM = 8
+
+
+# -- fixtures ----------------------------------------------------------------
+
+def _write_bmps(root: Path, n: int, hw: int = 6, seed: int = 0):
+    root.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        img = rng.integers(0, 256, (hw, hw, 3), dtype=np.uint8)
+        (root / f"img_{i:03d}.bmp").write_bytes(encode_bmp(img))
+
+
+def _ticker(start: float, tick: float):
+    t = [start]
+
+    def clk():
+        t[0] += tick
+        return t[0]
+
+    return clk
+
+
+class _Range(Dataset):
+    """In-memory source: the minimal custom-Dataset extension point."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def iter(self, epoch: int = 0) -> PipelineIterator:
+        return _RangeIter(self.n)
+
+
+class _RangeIter(PipelineIterator):
+    def __init__(self, n: int):
+        self._n, self._i = n, 0
+
+    def __next__(self):
+        if self._i >= self._n:
+            raise StopIteration
+        i = self._i
+        self._i += 1
+        rng = np.random.default_rng(i)
+        x = rng.normal(0, 1, (DIM,)).astype(np.float32)
+        return {"x": x, "y": (x * 0.5).astype(np.float32)}
+
+    def state_dict(self):
+        return {"i": self._i}
+
+    def load_state_dict(self, state):
+        self._i = int(state["i"])
+
+
+def _batches_equal(a, b):
+    assert set(a) == set(b), f"batch keys differ: {set(a)} vs {set(b)}"
+    for k in a:
+        assert a[k].dtype == b[k].dtype
+        assert np.array_equal(a[k], b[k]), f"column {k!r} differs"
+    return True
+
+
+# -- (a) streamed epoch == materialized Frame --------------------------------
+
+def test_streamed_epoch_matches_materialized_frame(tmp_path):
+    root = tmp_path / "imgs"
+    _write_bmps(root, 18)
+    (root / "junk.bin").write_bytes(b"this is not an image")
+
+    frame = read_images(str(root), sample_ratio=0.75, seed=3)
+    eager_paths = list(frame.column("path"))
+    eager_imgs = np.stack([iv.data for iv in frame.column("image")])
+    assert 0 < len(eager_paths) < 19  # the sample actually sampled
+
+    ds = (FileSource(str(root), sample_ratio=0.75, seed=3)
+          .decode(workers=3)
+          .batch(4, remainder="keep"))
+    with ds.iter() as it:
+        batches = list(it)
+    streamed_paths = [p for b in batches for p in b["path"]]
+    streamed_imgs = np.concatenate([b["image"] for b in batches])
+
+    assert streamed_paths == eager_paths  # same files, same order
+    assert streamed_imgs.dtype == eager_imgs.dtype
+    assert np.array_equal(streamed_imgs, eager_imgs)  # bit-identical pixels
+
+
+def test_decode_dropped_counter_in_both_paths(tmp_path):
+    root = tmp_path / "imgs"
+    _write_bmps(root, 2)
+    (root / "bad.bmp").write_bytes(b"BMnope")
+
+    c = obsmetrics.counter("data.decode_dropped")
+    before = c.value
+    frame = read_images(str(root))
+    assert frame.count() == 2
+    assert c.value == before + 1  # eager reader counted its drop
+
+    with FileSource(str(root)).decode(workers=2).batch(2).iter() as it:
+        rows = sum(len(b["path"]) for b in it)
+    assert rows == 2
+    assert c.value == before + 2  # streaming decode counted the same drop
+
+
+# -- shuffle -----------------------------------------------------------------
+
+def test_shuffle_is_seeded_and_folds_epoch(tmp_path):
+    root = tmp_path / "imgs"
+    _write_bmps(root, 12)
+    ds = (FileSource(str(root))
+          .map(lambda r: r["path"])
+          .shuffle(window=8, seed=7))
+
+    e0_a = list(ds.iter(0))
+    e0_b = list(ds.iter(0))
+    e1 = list(ds.iter(1))
+    assert e0_a == e0_b  # pure function of (seed, epoch, position)
+    assert sorted(e0_a) == sorted(e1) and e0_a != e1  # epoch reorders
+    other = (FileSource(str(root)).map(lambda r: r["path"])
+             .shuffle(window=8, seed=8))
+    assert list(other.iter(0)) != e0_a  # seed matters
+
+
+# -- batching ----------------------------------------------------------------
+
+def test_batcher_remainder_policies():
+    drop = list(_Range(10).batch(4, remainder="drop"))
+    assert len(drop) == 2 and all(b["x"].shape == (4, DIM) for b in drop)
+
+    keep = list(_Range(10).batch(4, remainder="keep"))
+    assert len(keep) == 3 and keep[-1]["x"].shape == (2, DIM)
+
+    pad = list(_Range(10).batch(4, remainder="pad"))
+    assert len(pad) == 3 and pad[-1]["x"].shape == (4, DIM)
+    last = pad[-1]
+    assert last["weight"].dtype == np.float32
+    assert np.array_equal(last["weight"], [1.0, 1.0, 0.0, 0.0])
+    assert np.array_equal(last["x"][2:], np.zeros((2, DIM), np.float32))
+    assert "weight" not in pad[0]  # full batches carry no mask
+
+    # the first two batches are identical across policies
+    for full, b in zip(drop, keep):
+        _batches_equal(full, b)
+
+
+def test_stack_records_object_and_scalar_columns():
+    rows = [{"path": f"p{i}", "n": i} for i in range(3)]
+    out = _stack_records(rows, pad_to=4)
+    assert out["path"].dtype == np.object_ and out["path"][3] is None
+    assert out["n"].tolist() == [0, 1, 2, 0]
+    assert np.array_equal(out["weight"], [1.0, 1.0, 1.0, 0.0])
+
+
+def test_stage_constructors_validate():
+    src = _Range(4)
+    with pytest.raises(ValueError):
+        FileSource("/nowhere", sample_ratio=0.0)
+    with pytest.raises(ValueError):
+        ShuffleBuffer(src, window=0)
+    with pytest.raises(ValueError):
+        ParallelDecode(src, workers=0)
+    with pytest.raises(ValueError):
+        ParallelDecode(src, chunk=0)
+    with pytest.raises(ValueError):
+        Batcher(src, 0)
+    with pytest.raises(ValueError):
+        Batcher(src, 4, remainder="wrap")
+    with pytest.raises(ValueError):
+        src.repeat(0)
+
+
+def test_data_config_keys_have_defaults():
+    assert config.get("data.shuffle_window") == 1024
+    assert config.get("data.decode_workers") == 4
+    assert config.get("data.prefetch_depth") == 0
+    # stages pick the configured defaults up
+    assert ShuffleBuffer(_Range(4)).window == 1024
+    assert ParallelDecode(_Range(4)).workers == 4
+
+
+# -- (b) mid-epoch crash/resume, pipeline level ------------------------------
+
+def _full_pipeline(root):
+    # chunk=2 keeps the decode read-ahead small so an injected fault lands
+    # after some batches have already been consumed (chunked submission
+    # runs ahead of consumption by up to 2*workers chunks)
+    return (FileSource(str(root))
+            .shuffle(window=8, seed=5)
+            .decode(workers=2, chunk=2)
+            .batch(4, remainder="drop")
+            .repeat(2))
+
+
+def test_resume_from_any_snapshot_is_bit_identical(tmp_path):
+    root = tmp_path / "imgs"
+    _write_bmps(root, 20)
+    ds = _full_pipeline(root)
+
+    full, states = [], []
+    with ds.iter() as it:
+        for batch in it:
+            full.append(batch)
+            # JSON round-trip: the exact bytes TrainCheckpointer persists
+            states.append(json.loads(json.dumps(it.state_dict())))
+    assert len(full) == 10  # 2 epochs x 20 files / batch 4
+
+    # k=4: exact epoch boundary; k=6: mid-epoch 1 (reshuffled pass)
+    for k in (4, 6):
+        with ds.iter() as it2:
+            it2.load_state_dict(states[k])
+            tail = list(it2)
+        assert len(tail) == len(full) - (k + 1)
+        for got, want in zip(tail, full[k + 1:]):
+            _batches_equal(got, want)
+
+
+def test_injected_crash_then_resume_replays_stream(tmp_path):
+    root = tmp_path / "imgs"
+    _write_bmps(root, 20)
+    ds = _full_pipeline(root)
+
+    with ds.iter() as it:
+        full = list(it)
+
+    got, states = [], []
+    with FaultPlan(FaultSpec("data.decode", on_hit=17)):
+        with ds.iter() as it:
+            with pytest.raises(InjectedFault):
+                for batch in it:
+                    got.append(batch)
+                    states.append(json.loads(json.dumps(it.state_dict())))
+    k = len(got)
+    assert 0 < k < len(full)  # died mid-epoch, with batches in flight
+    for a, b in zip(got, full[:k]):
+        _batches_equal(a, b)
+
+    with ds.iter() as it:
+        it.load_state_dict(states[-1])
+        rest = list(it)
+    assert len(rest) == len(full) - k
+    for a, b in zip(rest, full[k:]):
+        _batches_equal(a, b)  # resumed stream == uninterrupted stream
+
+
+def test_file_source_resume_requires_same_listing(tmp_path):
+    root = tmp_path / "imgs"
+    _write_bmps(root, 4)
+    with FileSource(str(root)).iter() as it:
+        next(it)
+        snap = it.state_dict()
+    _write_bmps(root, 6)  # corpus changed under the snapshot
+    with FileSource(str(root)).iter() as it:
+        with pytest.raises(ValueError, match="listing changed"):
+            it.load_state_dict(snap)
+
+
+# -- (c) decode runs off the consumer thread ---------------------------------
+
+def test_decode_runs_off_consumer_thread(tmp_path):
+    root = tmp_path / "imgs"
+    _write_bmps(root, 6)
+    consumer_ident = threading.get_ident()
+    record1_started = threading.Event()
+    worker_idents = []
+
+    def fn(rec):
+        idx = int(rec["path"][-7:-4])
+        worker_idents.append(threading.get_ident())
+        if idx == 0:
+            # Blocks until record 1's decode has STARTED. Serial decode on
+            # the consumer thread could never start record 1 while record 0
+            # is still decoding, so this would time out; overlapping pool
+            # workers satisfy it immediately.
+            overlapped = record1_started.wait(timeout=30)
+            return {"idx": idx, "overlapped": overlapped}
+        if idx == 1:
+            record1_started.set()
+        return {"idx": idx, "overlapped": True}
+
+    config.set("observability.metrics", True)
+    obsevents.set_clock(perf_fn=_ticker(0.0, 0.5))
+    try:
+        obsmetrics.get_registry().reset()
+        # chunk=1: one record per future, so records 0 and 1 land on
+        # DIFFERENT workers (within a chunk, records run serially on one)
+        ds = FileSource(str(root)).decode(fn=fn, workers=2, chunk=1)
+        with ds.iter() as it:
+            out = list(it)
+    finally:
+        config.unset("observability.metrics")
+        obsevents.reset_clock()
+
+    assert [o["idx"] for o in out] == list(range(6))  # submission order
+    assert all(o["overlapped"] for o in out)
+    assert consumer_ident not in worker_idents  # never on the consumer
+    # the injected clock drove the decode/wait instrumentation
+    reg = obsmetrics.get_registry()
+    assert reg.histogram("data.decode_seconds").count == 6
+    assert reg.histogram("data.decode_wait_seconds").count == 6
+    assert reg.histogram("data.decode_seconds").sum >= 6 * 0.5
+
+
+# -- telemetry: epoch events + run report ------------------------------------
+
+def test_data_epoch_events_and_report_section(tmp_path):
+    from mmlspark_tpu.observability.report import render_report
+    path = str(tmp_path / "events.jsonl")
+    config.set("observability.events_path", path)
+    obsevents.set_clock(wall_fn=_ticker(100.0, 1.0),
+                        perf_fn=_ticker(0.0, 1.0))
+    try:
+        list(_Range(8).batch(4).repeat(2))
+    finally:
+        config.unset("observability.events_path")
+        obsevents.reset_clock()
+    lines = [json.loads(ln) for ln in open(path) if ln.strip()]
+    epochs = [e for e in lines if e.get("name") == "data.epoch"]
+    assert [e["epoch"] for e in epochs] == [0, 1]
+    assert all(e["items"] == 2 for e in epochs)  # 2 batches per epoch
+
+    report = render_report(path)
+    assert "input pipeline:" in report
+    assert "epoch 0: 2 items" in report
+
+
+# -- device prefetch terminal stage + trainer integration --------------------
+
+def test_to_device_iterator_and_prefetch_shim():
+    from mmlspark_tpu.data.prefetch import DevicePrefetcher
+    from mmlspark_tpu.parallel import trainer as trainer_mod
+    # back-compat: the trainer re-exports the moved class, same object
+    assert trainer_mod.DevicePrefetcher is DevicePrefetcher
+
+    seen = []
+    pf = _Range(8).batch(4).to_device_iterator(put=seen.append, depth=2)
+    out = list(pf)
+    assert len(out) == 2 and len(seen) == 2
+    _batches_equal(seen[0], next(iter(_Range(8).batch(4))))
+    pf.close()
+    pf.close()  # idempotent — the TrainCheckpointer.close() contract
+
+
+def _make_trainer():
+    mesh = make_mesh(MeshSpec(data=4, tensor=2))
+
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return ((pred - batch["y"]) ** 2).mean()
+
+    return DistributedTrainer(loss_fn, optax.adam(1e-2), mesh=mesh)
+
+
+def _init_params():
+    return {"w": jnp.ones((DIM, DIM), jnp.float32) * 0.1,
+            "b": jnp.zeros((DIM,), jnp.float32)}
+
+
+def _tree_equal(a, b):
+    fa, ta = jax.tree_util.tree_flatten(jax.device_get(a))
+    fb, tb = jax.tree_util.tree_flatten(jax.device_get(b))
+    assert ta == tb, f"tree structure differs: {ta} vs {tb}"
+    return all(np.array_equal(x, y) for x, y in zip(fa, fb))
+
+
+def test_trainer_fit_accepts_dataset():
+    ds = _Range(32).batch(8, remainder="drop")
+
+    t_ds = _make_trainer()
+    s_ds, l_ds = t_ds.fit(t_ds.init(_init_params), ds)
+
+    with ds.iter() as it:
+        materialized = list(it)
+    t_mat = _make_trainer()
+    s_mat, l_mat = t_mat.fit(t_mat.init(_init_params), materialized)
+
+    assert len(l_ds) == len(l_mat) == 4
+    assert np.array_equal(l_ds, l_mat)
+    assert _tree_equal(s_ds, s_mat)
+
+
+# -- (b) end to end: ResilientTrainLoop.run_dataset --------------------------
+
+def _float_file_pipeline(root: Path):
+    def parse(rec):
+        x = np.frombuffer(rec["bytes"], np.float32)
+        return {"x": x, "y": (x * 0.5).astype(np.float32)}
+
+    return (FileSource(str(root))
+            .map(parse)
+            .shuffle(window=16, seed=9)
+            .batch(8, remainder="drop")
+            .repeat())
+
+
+def test_run_dataset_crash_resume_bit_identical(tmp_path):
+    root = tmp_path / "vecs"
+    root.mkdir()
+    for i in range(32):
+        rng = np.random.default_rng(i)
+        vec = rng.normal(0, 1, (DIM,)).astype(np.float32)
+        (root / f"r_{i:03d}.bin").write_bytes(vec.tobytes())
+    # 32 records / batch 8 = 4 steps per epoch; 10 steps spans 3 epochs and
+    # every checkpoint (save_every=3 -> steps 3, 6, 9) lands MID-epoch
+    total = 10
+
+    ck_full = TrainCheckpointer(str(tmp_path / "ck_full"))
+    loop = ResilientTrainLoop(_make_trainer(), ck_full, _init_params,
+                              save_every=3)
+    s_full = loop.run_dataset(_float_file_pipeline(root), total)
+    ck_full.close()
+
+    ck_a = TrainCheckpointer(str(tmp_path / "ck_crash"))
+    loop_a = ResilientTrainLoop(_make_trainer(), ck_a, _init_params,
+                                save_every=3)
+    with FaultPlan(FaultSpec("trainer.train_step", on_hit=8)):
+        with pytest.raises(InjectedFault):
+            loop_a.run_dataset(_float_file_pipeline(root), total)
+    ck_a.wait()
+    assert ck_a.latest_step() == 6
+    snap = ck_a.get_data_state(6)
+    assert snap is not None and snap["epoch"] == 1  # mid-epoch snapshot
+    ck_a.close()
+
+    # process-equivalent restart: fresh trainer, checkpointer, pipeline
+    ck_b = TrainCheckpointer(str(tmp_path / "ck_crash"))
+    loop_b = ResilientTrainLoop(_make_trainer(), ck_b, _init_params,
+                                save_every=3)
+    s_res = loop_b.run_dataset(_float_file_pipeline(root), total)
+    assert _tree_equal(s_full, s_res)
+
+    # a finite stream that runs dry mid-run surfaces a clear error
+    ck_c = TrainCheckpointer(str(tmp_path / "ck_short"))
+    loop_c = ResilientTrainLoop(loop_b.trainer, ck_c, _init_params,
+                                save_every=0)
+    short = (FileSource(str(root))
+             .map(lambda r: {"x": np.frombuffer(r["bytes"], np.float32),
+                             "y": np.frombuffer(r["bytes"], np.float32)})
+             .batch(8, remainder="drop"))
+    with pytest.raises(ValueError, match="exhausted"):
+        loop_c.run_dataset(short, 6)
+    ck_c.close()
+    ck_b.close()
+
+
+# -- bench config runs end to end on CPU -------------------------------------
+
+def test_streaming_input_bench_runs(tmp_path):
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    assert "streaming_input" in bench.CONFIGS
+    assert bench.CONFIG_UNITS["streaming_input"] == "rows/sec"
+    result = bench.config_streaming_input()
+    assert result["value"] > 0
+    assert result["unit"] == "rows/sec"
+    assert result["vs_baseline"] > 0
+    assert result["rows"] == result["batch"] * (result["rows"]
+                                                // result["batch"])

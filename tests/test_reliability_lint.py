@@ -167,6 +167,39 @@ def test_accepts_thread_with_explicit_daemon_either_way():
     """) == []
 
 
+def test_flags_queue_without_maxsize():
+    probs = _problems("""
+        import queue
+
+        def build():
+            return queue.Queue()
+    """)
+    assert len(probs) == 1 and "maxsize" in probs[0]
+    assert "mod.py:5" in probs[0]
+
+
+def test_accepts_queue_with_explicit_maxsize():
+    assert _problems("""
+        import queue
+        from queue import Queue
+
+        def a(depth):
+            return queue.Queue(maxsize=depth)
+
+        def b():
+            return Queue(16)                 # positional bound
+
+        def c():
+            return Queue(maxsize=0)          # unbounded, but DELIBERATE
+
+        def d(**kw):
+            return Queue(**kw)               # caller decides
+
+        def e(obj):
+            return obj.build_queue()         # not a Queue ctor
+    """) == []
+
+
 def test_syntax_error_is_reported_not_crashing(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text("def broken(:\n")
