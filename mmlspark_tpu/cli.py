@@ -17,7 +17,8 @@ scales from laptop CPU to a multi-host slice without edits.
 Other subcommands: ``info`` (device + config inventory), ``bench`` (runs
 the repo benchmark when present), ``serve`` (the micro-batching inference
 server over HTTP — docs/SERVING.md), ``check`` (reliability lint),
-``report`` (render a telemetry event log).
+``chaos`` (seeded train-kill-resume-then-serve fault scenario —
+docs/RELIABILITY.md), ``report`` (render a telemetry event log).
 """
 from __future__ import annotations
 
@@ -292,10 +293,16 @@ def _parse_model_flag(text: str):
 
 def cmd_serve(args, passthrough) -> int:
     """Start the micro-batching inference server behind the stdlib HTTP
-    front-end (docs/SERVING.md). Blocks until interrupted."""
+    front-end (docs/SERVING.md). Blocks until interrupted; SIGTERM/SIGINT
+    drain gracefully — admission stops (503 + Retry-After), in-flight
+    batches finish, then the server closes (docs/RELIABILITY.md)."""
+    import threading
     from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.reliability import preemption
+    from mmlspark_tpu.reliability.watchdog import Watchdog
     from mmlspark_tpu.serve.http import serve_http
     from mmlspark_tpu.serve.server import Server
+    from mmlspark_tpu.utils import config as mmlconfig
     if not args.model:
         raise SystemExit(
             "serve: at least one --model NAME=ARCH[:JSON-kwargs] required "
@@ -319,14 +326,50 @@ def cmd_serve(args, passthrough) -> int:
     # wrappers can discover an ephemeral --port 0
     print(json.dumps({"serving": addr,                 # lint: allow-print
                       "models": server.registry.names()}))
+    # graceful preemption: SIGTERM/SIGINT flip the process-wide signal;
+    # this monitor turns it into drain (stop admission, finish in-flight)
+    # then unblocks serve_forever. Handlers only install on the main
+    # thread — in-process callers off-main keep plain Ctrl-C semantics.
+    preemption.install_handlers()
+    watchdog = Watchdog() \
+        if float(mmlconfig.get("reliability.stall_timeout_s")) > 0 else None
+
+    def monitor():
+        preemption.get_signal().wait()
+        server.drain(reason=preemption.preemption_reason() or "signal")
+        httpd.shutdown()
+
+    mon = threading.Thread(target=monitor, daemon=True,
+                           name="mmlspark-tpu-serve-drain")
+    mon.start()
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
-        pass  # clean Ctrl-C shutdown path
+        pass  # clean Ctrl-C shutdown path (no handler installed off-main)
     finally:
         httpd.server_close()
         server.close()
+        if watchdog is not None:
+            watchdog.close()
     return 0
+
+
+def cmd_chaos(args, passthrough) -> int:
+    """Seeded chaos scenario (docs/RELIABILITY.md): train under a
+    deterministic fault schedule generated from --seed, kill + resume to
+    bit-identical params, then serve traffic under injected faults while
+    polling /healthz. Writes ``chaos_verdict.json`` under --out; exit 0
+    iff every invariant held."""
+    from mmlspark_tpu.reliability import chaos
+    outdir = args.out or os.path.join(
+        os.getcwd(), f"chaos-seed{args.seed}")
+    verdict = chaos.run_scenario(
+        args.seed, outdir, total_steps=args.steps,
+        save_every=args.save_every, requests=args.requests)
+    # stdout contract: the verdict JSON, so wrappers don't re-read the file
+    print(json.dumps(verdict, indent=2,       # lint: allow-print
+                     sort_keys=True))
+    return 0 if verdict["passed"] else 1
 
 
 def cmd_bench(args, passthrough) -> int:
@@ -431,6 +474,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                          help='batch-shape buckets, e.g. "1,8,64" '
                          "(serving.buckets)")
     serve_p.set_defaults(fn=cmd_serve)
+
+    chaos_p = sub.add_parser(
+        "chaos",
+        help="seeded train-kill-resume-then-serve chaos scenario; exits "
+             "0 iff all invariants hold")
+    chaos_p.add_argument("--seed", type=int, default=0,
+                         help="fault-schedule seed (same seed => same "
+                         "kills, same verdict)")
+    chaos_p.add_argument("--out", default="",
+                         help="verdict/checkpoint directory (default "
+                         "./chaos-seed<SEED>)")
+    chaos_p.add_argument("--steps", type=int, default=8,
+                         help="train steps in each run (default 8)")
+    chaos_p.add_argument("--save-every", type=int, default=2,
+                         help="checkpoint cadence in steps (default 2)")
+    chaos_p.add_argument("--requests", type=int, default=12,
+                         help="serve-phase request count (default 12)")
+    chaos_p.set_defaults(fn=cmd_chaos)
 
     report_p = sub.add_parser(
         "report", help="render a run report from a telemetry event log")

@@ -47,6 +47,7 @@ import numpy as np
 from mmlspark_tpu.data.prefetch import DevicePrefetcher
 from mmlspark_tpu.observability import events as obsevents
 from mmlspark_tpu.observability import metrics as obsmetrics
+from mmlspark_tpu.reliability import watchdog as _watchdog
 from mmlspark_tpu.reliability.faults import fault_site
 from mmlspark_tpu.utils import config as mmlconfig
 
@@ -371,15 +372,23 @@ class _DecodeIter(PipelineIterator):
         self._exhausted = False
         self._consumed = up.state_dict()
         self._telemetry = obsmetrics.metrics_enabled()
+        # liveness: workers beat per decoded record (atomic write, shared
+        # handle) — a hung codec shows as this heartbeat going silent
+        self._hb = _watchdog.register("data.decode")
 
     def _run(self, recs: List[Record]) -> List[Optional[Record]]:
         if not self._telemetry:
-            return [self._fn(r) for r in recs]
+            out = []
+            for r in recs:
+                out.append(self._fn(r))
+                self._hb.beat()
+            return out
         out = []
         hist = obsmetrics.histogram("data.decode_seconds")
         for r in recs:
             t0 = obsevents.perf()
             out.append(self._fn(r))
+            self._hb.beat()
             hist.observe(obsevents.perf() - t0)
         return out
 
@@ -444,6 +453,7 @@ class _DecodeIter(PipelineIterator):
         self._ready.clear()
 
     def close(self) -> None:
+        self._hb.close()
         self._abandon_inflight()
         self._pool.shutdown(wait=True, cancel_futures=True)
         self._up.close()

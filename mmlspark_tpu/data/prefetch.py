@@ -15,6 +15,7 @@ import threading
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional
 
 from mmlspark_tpu.observability import metrics as obsmetrics
+from mmlspark_tpu.reliability import watchdog as _watchdog
 from mmlspark_tpu.utils import config as mmlconfig
 
 
@@ -51,8 +52,14 @@ class DevicePrefetcher:
         self._telemetry = obsmetrics.metrics_enabled()
 
         def run():
+            # liveness: beats on every produced batch AND on every bounded
+            # wait tick — a producer parked on a full queue is healthy
+            # (back-pressure), one wedged inside next(host_batches) is the
+            # stall the watchdog should catch
+            beat = _watchdog.register("data.prefetch")
             try:
                 for hb in host_batches:
+                    beat.beat()
                     if self._stop.is_set():
                         return
                     # bounded put that notices close(): never blocks forever
@@ -61,10 +68,12 @@ class DevicePrefetcher:
                             self._q.put(hb, timeout=0.1)
                             break
                         except queue.Full:
+                            beat.beat()
                             continue
             except BaseException as e:  # surfaced on the consumer side
                 self._err = e
             finally:
+                beat.close()
                 # bounded sentinel put: a full queue must not lose the
                 # end-of-stream marker, but close() must still unblock us
                 while not self._stop.is_set():

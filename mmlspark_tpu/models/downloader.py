@@ -18,8 +18,10 @@ import hashlib
 import io
 import json
 import os
+import urllib.error
 import urllib.request
 from dataclasses import asdict, dataclass, field
+from email.utils import parsedate_to_datetime
 from typing import Any, Dict, Iterable, List, Optional, Union
 
 import numpy as np
@@ -133,6 +135,27 @@ class LocalRepo(Repository):
         return path
 
 
+def _parse_retry_after(value: Optional[str]) -> Optional[float]:
+    """``Retry-After`` header -> seconds (delta-seconds or HTTP-date form);
+    None when absent or unparseable. Never raises — a malformed header
+    must not turn a retryable 503 into a crash."""
+    if not value:
+        return None
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        pass
+    try:
+        import datetime
+        when = parsedate_to_datetime(value)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        if when.tzinfo is None:
+            when = when.replace(tzinfo=datetime.timezone.utc)
+        return max(0.0, (when - now).total_seconds())
+    except (TypeError, ValueError):
+        return None
+
+
 class HttpRepo(Repository):
     """Remote repository: <base>/MANIFEST lists schema JSON, one per line.
 
@@ -148,7 +171,10 @@ class HttpRepo(Repository):
 
     def __init__(self, base_url: str, cache: Union[LocalRepo, str],
                  timeout: Optional[float] = None,
-                 retry: Optional["RetryPolicy"] = None):
+                 retry: Optional["RetryPolicy"] = None,
+                 breaker: Optional["CircuitBreaker"] = None):
+        from urllib.parse import urlparse
+        from mmlspark_tpu.reliability.breaker import breaker_for
         from mmlspark_tpu.utils import config
         self.base_url = base_url.rstrip("/")
         self.cache = LocalRepo(cache) if isinstance(cache, str) else cache
@@ -158,11 +184,31 @@ class HttpRepo(Repository):
             max_attempts=int(config.get("reliability.max_attempts")),
             base_delay=float(config.get("reliability.base_delay")),
             name="downloader")
+        # one breaker per repo HOST (process-wide): when the registry is
+        # down, every HttpRepo instance pointed at it fails fast together
+        # instead of each burning its own backoff schedule
+        host = urlparse(self.base_url).netloc or self.base_url
+        self.breaker = breaker if breaker is not None \
+            else breaker_for(f"downloader.{host}")
 
     def _fetch(self, url: str) -> bytes:
+        """One guarded fetch: the circuit breaker wraps the socket work,
+        and a 429/503 response's ``Retry-After`` header is attached to the
+        re-raised error (``retry_after`` seconds) so the retry layer backs
+        off for as long as the server asked, not just its own schedule."""
         fault_site("downloader.fetch")
-        with urllib.request.urlopen(url, timeout=self.timeout) as r:
-            data = r.read()
+
+        def _read() -> bytes:
+            try:
+                with urllib.request.urlopen(url, timeout=self.timeout) as r:
+                    return r.read()
+            except urllib.error.HTTPError as e:
+                if e.code in (429, 503):
+                    e.retry_after = _parse_retry_after(
+                        e.headers.get("Retry-After"))
+                raise
+
+        data = self.breaker.call(_read)
         return fault_site("downloader.payload", payload=data)
 
     def list_schemas(self) -> List[ModelSchema]:

@@ -9,6 +9,9 @@ The offline half of the telemetry loop (``mmlspark-tpu report
 - slowest individual spans (the long-tail view the aggregate hides);
 - reliability activity: retry attempts, fault-site hits, checkpoint
   quarantines, by site;
+- liveness: watchdog stalls (per heartbeat, longest silence),
+  circuit-breaker transitions, preemption signals/drains, quarantined
+  data-state sidecars;
 - throughput: the ``train.fit`` / ``train.step`` summaries the trainer and
   MetricLogger emit (steps, rows, examples/sec), plus any bench results;
 - serving: per-request SLO breakdown from the serve subsystem's
@@ -136,6 +139,50 @@ def render_report(path: str, top: int = 10) -> str:
             steps = [e.get("step") for e in quarantines]
             out.append(f"  checkpoint quarantines: {len(quarantines)} "
                        f"(steps {steps})")
+        out.append("")
+
+    # -- liveness ------------------------------------------------------------
+    stalls = [e for e in plain if e.get("name") == "watchdog.stall"]
+    trips = [e for e in plain
+             if str(e.get("name", "")).startswith("breaker.")]
+    preempts = [e for e in plain if e.get("name") == "preemption.signal"]
+    drains = [e for e in plain if e.get("name") == "preemption.drain"]
+    ds_quar = [e for e in plain
+               if e.get("name") == "checkpoint.data_state_quarantine"]
+    if stalls or trips or preempts or drains or ds_quar:
+        out.append("liveness:")
+        if stalls:
+            by_hb: Dict[str, int] = defaultdict(int)
+            for e in stalls:
+                by_hb[e.get("heartbeat", "?")] += 1
+            detail = ", ".join(f"{k}={v}" for k, v in sorted(by_hb.items()))
+            worst = max(float(e.get("stalled_s", 0.0)) for e in stalls)
+            out.append(f"  watchdog stalls: {len(stalls)} ({detail}); "
+                       f"longest {worst:.1f}s (stacks in the event log)")
+        if trips:
+            by_key: Dict[str, List[str]] = defaultdict(list)
+            for e in trips:
+                by_key[e.get("key", "?")].append(
+                    str(e.get("name", "")).split(".", 1)[-1])
+            detail = ", ".join(f"{k}: {'->'.join(v)}"
+                               for k, v in sorted(by_key.items()))
+            opened = sum(1 for e in trips if e.get("name") == "breaker.open")
+            out.append(f"  breaker transitions: {len(trips)} "
+                       f"({opened} trips to open) [{detail}]")
+        if preempts or drains:
+            reasons = sorted({str(e.get("reason", "?"))
+                              for e in preempts + drains})
+            kinds = ", ".join(
+                f"{e.get('kind', '?')}@step {e.get('step')}"
+                if "step" in e else str(e.get("kind", "?"))
+                for e in drains)
+            out.append(f"  preemptions: {len(preempts)} signalled, "
+                       f"{len(drains)} clean drains"
+                       + (f" ({kinds})" if kinds else "")
+                       + (f"; reasons: {', '.join(reasons)}"
+                          if reasons else ""))
+        if ds_quar:
+            out.append(f"  data-state sidecars quarantined: {len(ds_quar)}")
         out.append("")
 
     # -- serving -------------------------------------------------------------

@@ -22,6 +22,7 @@ latest step and training continues bit-identically (fold_in(step) keys).
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
@@ -141,23 +142,66 @@ class TrainCheckpointer:
         """Persist an input-pipeline ``state_dict`` for ``step`` (call it
         just BEFORE ``save(step)``: an orphan snapshot for an uncommitted
         step is harmless, a committed step without its snapshot loses
-        mid-epoch resume). Returns the written path."""
+        mid-epoch resume). The snapshot is wrapped with a sha256 of its
+        canonical JSON, verified at :meth:`get_data_state` — the sidecar
+        gets the same torn-write/bitrot protection orbax gives the params.
+        Returns the written path."""
         path = self._data_state_path(step)
+        body = json.dumps(state, sort_keys=True)
+        wrapper = {"sha256": hashlib.sha256(body.encode()).hexdigest(),
+                   "state": state}
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(state, f)
+            json.dump(wrapper, f, sort_keys=True)
         os.replace(tmp, path)
         self._gc_data_state(keep_step=step)
         return path
 
     def get_data_state(self, step: int) -> Optional[Dict[str, Any]]:
         """This process's pipeline snapshot for ``step``, or None when the
-        checkpoint predates the streaming pipeline (params-only resume)."""
+        checkpoint predates the streaming pipeline (params-only resume) OR
+        the sidecar fails integrity checks. A corrupt/mismatched sidecar
+        is QUARANTINED (renamed aside, like a corrupt checkpoint step) —
+        resuming the stream from its beginning costs duplicate batches;
+        resuming from a silently corrupt cursor is wrong forever."""
+        path = self._data_state_path(step)
         try:
-            with open(self._data_state_path(step)) as f:
-                return json.load(f)
+            with open(path) as f:
+                payload = json.load(f)
         except FileNotFoundError:
             return None
+        except ValueError:
+            return self._quarantine_data_state(path, "unparseable JSON")
+        if not (isinstance(payload, dict) and "sha256" in payload
+                and "state" in payload):
+            # pre-sha256 sidecar (older writer): no integrity field to
+            # check, load it as-is for backward compatibility
+            return payload if isinstance(payload, dict) else \
+                self._quarantine_data_state(path, "not a JSON object")
+        body = json.dumps(payload["state"], sort_keys=True)
+        actual = hashlib.sha256(body.encode()).hexdigest()
+        if actual != payload["sha256"]:
+            return self._quarantine_data_state(
+                path, f"sha256 {actual[:12]} != recorded "
+                f"{str(payload['sha256'])[:12]}")
+        return payload["state"]
+
+    def _quarantine_data_state(self, path: str,
+                               why: str) -> None:
+        quarantined = os.path.join(
+            os.path.dirname(path), "corrupt-" + os.path.basename(path))
+        _LOG.warning("data-state sidecar %s failed verification (%s); "
+                     "quarantined to %s — the input stream restarts",
+                     path, why, quarantined)
+        try:
+            os.replace(path, quarantined)
+        except OSError as e:   # already moved by a concurrent reader
+            _LOG.debug("data-state quarantine skipped (%s)", e)
+        obsmetrics.counter("checkpoint.data_state_quarantined").inc()
+        if obsevents.events_enabled():
+            obsevents.emit("event", "checkpoint.data_state_quarantine",
+                           path=path, reason=why)
+        return None
 
     def _gc_data_state(self, keep_step: int) -> None:
         """Drop snapshots for steps orbax has pruned (max_to_keep); the

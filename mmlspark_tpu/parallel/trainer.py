@@ -33,6 +33,7 @@ from mmlspark_tpu.data.prefetch import DevicePrefetcher  # noqa: F401
 from mmlspark_tpu.parallel.mesh import mesh_from_config
 from mmlspark_tpu.observability import events as obsevents
 from mmlspark_tpu.observability import metrics as obsmetrics
+from mmlspark_tpu.reliability import watchdog as _watchdog
 from mmlspark_tpu.reliability.faults import fault_site
 from mmlspark_tpu.parallel.sharding import (
     active_batch_axes, batch_sharding, is_cpu_mesh, local_batch_rows,
@@ -456,8 +457,13 @@ class DistributedTrainer:
             step_hist = obsmetrics.histogram("trainer.step_time_seconds")
             t_start = t_prev = obsevents.perf()
         prefetcher = DevicePrefetcher(batches, self.put_batch, depth=prefetch)
+        # liveness: one beat per dispatched step — a wedged collective or
+        # stuck input shows up as this heartbeat going silent, and the
+        # watchdog dumps every thread's stack while the hang is live
+        hb = _watchdog.register("trainer.fit")
         try:
             for i, batch in enumerate(prefetcher):
+                hb.beat()
                 state, metrics = self.train_step(state, batch, rng)
                 losses.append(metrics["loss"])  # device scalar: no per-step sync
                 if telemetry:
@@ -481,6 +487,7 @@ class DistributedTrainer:
                     metric_log(i, {"loss": losses[-1]},  # sync off-cadence)
                                batch_rows=rows)
         finally:
+            hb.close()          # deregister: a finished fit never "stalls"
             prefetcher.close()  # stops the producer if we exited early
             closer = getattr(batches, "close", None)
             if callable(closer):  # pipeline iterators own decode pools

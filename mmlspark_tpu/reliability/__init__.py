@@ -1,26 +1,53 @@
-"""Reliability subsystem: retry/backoff, deterministic fault injection, and
-crash-safe training (ISSUE 1).
+"""Reliability subsystem: retry/backoff, deterministic fault injection,
+crash-safe training (ISSUE 1), and the liveness layer — watchdog,
+preemption-aware shutdown, circuit breakers, seeded chaos (ISSUE 5).
 
 - :mod:`mmlspark_tpu.reliability.retry` — :class:`RetryPolicy`, the shared
   exponential-backoff primitive (deterministic jitter, deadline, retryable
-  predicate);
+  predicate, ``Retry-After`` honor);
 - :mod:`mmlspark_tpu.reliability.faults` — :func:`fault_site` hooks +
   :class:`FaultPlan`, bit-for-bit reproducible failure injection;
 - :mod:`mmlspark_tpu.reliability.resilient` — :class:`ResilientTrainLoop`,
   the crash-safe trainer/checkpointer driver with corrupt-checkpoint
-  fallback;
-- :mod:`mmlspark_tpu.reliability.lint` — the static ``urlopen``-timeout /
-  swallowed-except gate behind ``mmlspark-tpu check``.
+  fallback and preemption-drain exit;
+- :mod:`mmlspark_tpu.reliability.watchdog` — heartbeat registry +
+  :class:`Watchdog` stall detector with all-thread stack dumps;
+- :mod:`mmlspark_tpu.reliability.preemption` — SIGTERM/SIGINT ->
+  process-wide :class:`PreemptionSignal`, polled by train/serve loops;
+- :mod:`mmlspark_tpu.reliability.breaker` — :class:`CircuitBreaker`
+  (closed/open/half-open) above the retry layer;
+- :mod:`mmlspark_tpu.reliability.chaos` — seeded randomized fault
+  schedules + the ``mmlspark-tpu chaos`` train-kill-resume-serve scenario;
+- :mod:`mmlspark_tpu.reliability.lint` — the static gate behind
+  ``mmlspark-tpu check`` (urlopen timeouts, swallowed excepts, print,
+  thread daemon, queue bounds, signal-handler centralization).
 """
+from mmlspark_tpu.reliability.breaker import (
+    CircuitBreaker, CircuitOpen, breaker_for, reset_breakers,
+)
+from mmlspark_tpu.reliability.chaos import (
+    ChaosError, generate_serve_plan, generate_train_plan, run_scenario,
+)
 from mmlspark_tpu.reliability.faults import (
     FaultPlan, FaultSpec, InjectedFault, active_plan, fault_site,
+)
+from mmlspark_tpu.reliability.preemption import (
+    PreemptionSignal, install_handlers, preempted, preemption_reason,
+    request_preemption,
 )
 from mmlspark_tpu.reliability.resilient import ResilientTrainLoop
 from mmlspark_tpu.reliability.retry import (
     Attempt, RetryPolicy, default_retryable,
 )
+from mmlspark_tpu.reliability.watchdog import Heartbeat, Stall, Watchdog
+from mmlspark_tpu.reliability.watchdog import register as register_heartbeat
 
 __all__ = [
-    "Attempt", "FaultPlan", "FaultSpec", "InjectedFault", "RetryPolicy",
-    "ResilientTrainLoop", "active_plan", "default_retryable", "fault_site",
+    "Attempt", "ChaosError", "CircuitBreaker", "CircuitOpen", "FaultPlan",
+    "FaultSpec", "Heartbeat", "InjectedFault", "PreemptionSignal",
+    "ResilientTrainLoop", "RetryPolicy", "Stall", "Watchdog", "active_plan",
+    "breaker_for", "default_retryable", "fault_site",
+    "generate_serve_plan", "generate_train_plan", "install_handlers",
+    "preempted", "preemption_reason", "register_heartbeat",
+    "request_preemption", "reset_breakers", "run_scenario",
 ]

@@ -1,0 +1,350 @@
+"""Seeded chaos harness: deterministic fault schedules + a verdict.
+
+The tentpole scenario (``mmlspark-tpu chaos --seed N``):
+
+1. **reference** — an uninterrupted :class:`ResilientTrainLoop` run on a
+   tiny deterministic problem (params are a pure function of the seed);
+2. **chaos** — the same run under a :class:`FaultPlan` *generated from the
+   seed*: at least one mid-run kill (``trainer.train_step`` or
+   ``checkpoint.save``), maybe a poisoned restore (exercising the
+   quarantine-and-fall-back path), maybe tiny injected delays. Every
+   ``InjectedFault`` that escapes the loop is "the process died"; the
+   harness restarts the loop the way an operator (or a supervisor) would
+   rerun the program, until the run completes;
+3. **serve** — an HTTP server over a registry model takes traffic while
+   seeded ``serve.*`` faults fire; ``/healthz`` is polled throughout and
+   must answer every time, then the server drains and a second ``close()``
+   proves idempotence.
+
+Invariants asserted (the verdict JSON records each one):
+
+- ``params_bit_identical``   — chaos-run final params == reference params;
+- ``final_checkpoint_loads`` — a FRESH checkpointer restores the last step
+  and it matches the in-memory state (no corrupt checkpoint survived);
+- ``server_stays_live``      — every ``/healthz`` poll answered 200;
+- ``no_unhandled_exceptions``— nothing escaped outside the injected
+  fault channel.
+
+Everything derives from ``seed`` — two runs with the same seed produce the
+same fault schedule, the same kill points, and the same verdict, which is
+what makes a red chaos run *debuggable* instead of an anecdote.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+from mmlspark_tpu.reliability.faults import (FaultPlan, FaultSpec,
+                                             InjectedFault)
+from mmlspark_tpu.utils.logging import get_logger
+
+_LOG = get_logger("reliability.chaos")
+
+VERDICT_FILE = "chaos_verdict.json"
+
+# Sites the TRAIN phase draws its schedule from. `trainer.train_step` /
+# `checkpoint.save` raises are kills (the loop restarts); a
+# `checkpoint.restore` raise poisons the newest checkpoint ONCE, forcing
+# the quarantine-and-fall-back path on resume; delays exercise timeout
+# plumbing without changing any numerics.
+TRAIN_KILL_SITES = ("trainer.train_step", "checkpoint.save")
+TRAIN_DELAY_SITES = ("checkpoint.save.commit", "checkpoint.restore")
+# SERVE-phase fault sites (see faults.py's site inventory).
+SERVE_FAULT_SITES = ("serve.enqueue", "serve.batch", "serve.score")
+
+_DIM = 8
+
+
+class ChaosError(RuntimeError):
+    """The scenario itself failed to make progress (distinct from an
+    injected fault, which is the scenario working as designed)."""
+
+
+# -- plan generation ---------------------------------------------------------
+
+def generate_train_plan(seed: int, total_steps: int,
+                        sleep: Optional[Callable[[float], None]] = None
+                        ) -> FaultPlan:
+    """A randomized-but-deterministic fault schedule for the train phase.
+
+    Always contains at least one kill so the resume path is exercised;
+    hit counts accumulate across restarts (the plan stays installed), so
+    later kills land in the *resumed* run.
+    """
+    rng = random.Random(seed)
+    specs: List[FaultSpec] = []
+    # guaranteed kill, mid-run: never on hit 1 (a run that dies before any
+    # checkpoint proves nothing about resume)
+    site = rng.choice(TRAIN_KILL_SITES)
+    if site == "trainer.train_step":
+        specs.append(FaultSpec(site, on_hit=rng.randint(2, total_steps)))
+    else:
+        specs.append(FaultSpec(site, on_hit=rng.randint(1, 2)))
+    # optional second kill, landing during the resumed run's replay
+    if rng.random() < 0.5:
+        specs.append(FaultSpec(
+            "trainer.train_step",
+            on_hit=total_steps + rng.randint(1, total_steps)))
+    # optional poisoned restore: the FIRST restore after the kill fails,
+    # forcing quarantine of the newest step and fall-back to the previous
+    if rng.random() < 0.5:
+        specs.append(FaultSpec("checkpoint.restore", on_hit=1))
+    # optional tiny delays (timeout plumbing, not numerics)
+    for delay_site in TRAIN_DELAY_SITES:
+        if rng.random() < 0.5:
+            specs.append(FaultSpec(delay_site, on_hit=rng.randint(1, 3),
+                                   action="delay", delay=0.001))
+    kwargs = {"sleep": sleep} if sleep is not None else {}
+    return FaultPlan(*specs, **kwargs)
+
+
+def generate_serve_plan(seed: int, requests: int) -> FaultPlan:
+    """Seeded faults for the serve phase: a couple of scoring/admission
+    failures, few enough that the per-model circuit breaker (default
+    threshold 5 consecutive) never opens — the invariant under test is
+    *liveness*, not breaker behavior."""
+    rng = random.Random(seed ^ 0x5EEDED)
+    specs = [FaultSpec("serve.score", on_hit=rng.randint(2, max(2, requests // 2)))]
+    if rng.random() < 0.5:
+        specs.append(FaultSpec("serve.enqueue",
+                               on_hit=rng.randint(2, max(2, requests - 1))))
+    return FaultPlan(*specs)
+
+
+# -- deterministic tiny workload --------------------------------------------
+
+def _make_trainer():
+    import optax
+    from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mmlspark_tpu.parallel.trainer import DistributedTrainer
+    mesh = make_mesh(MeshSpec(data=-1))
+
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return ((pred - batch["y"]) ** 2).mean()
+
+    return DistributedTrainer(loss_fn, optax.adam(1e-2), mesh=mesh)
+
+
+def _init_params():
+    import jax.numpy as jnp
+    return {"w": jnp.ones((_DIM, _DIM), jnp.float32) * 0.1,
+            "b": jnp.zeros((_DIM,), jnp.float32)}
+
+
+def _batch_fn(seed: int) -> Callable[[int], Dict[str, Any]]:
+    import numpy as np
+
+    def batch(step: int) -> Dict[str, Any]:
+        rng = np.random.default_rng((seed << 20) + step)
+        x = rng.normal(0, 1, (16, _DIM)).astype(np.float32)
+        return {"x": x, "y": (x * 0.5).astype(np.float32)}
+
+    return batch
+
+
+def _bit_identical(a: Any, b: Any) -> bool:
+    import jax
+    import numpy as np
+    fa, ta = jax.tree_util.tree_flatten(jax.device_get(a))
+    fb, tb = jax.tree_util.tree_flatten(jax.device_get(b))
+    if ta != tb:
+        return False
+    return all(np.array_equal(x, y) for x, y in zip(fa, fb))
+
+
+# -- scenario phases ---------------------------------------------------------
+
+def _run_loop_to_completion(ckdir: str, batch_fn, total_steps: int,
+                            save_every: int, max_restarts: int) -> Any:
+    """Run a ResilientTrainLoop to completion, restarting on every escaped
+    InjectedFault exactly the way a supervisor reruns a killed program.
+    The active FaultPlan's hit counters persist across restarts, so the
+    schedule is deterministic end-to-end."""
+    from mmlspark_tpu.parallel.checkpoint import TrainCheckpointer
+    from mmlspark_tpu.reliability.resilient import ResilientTrainLoop
+    restarts = 0
+    while True:
+        loop = ResilientTrainLoop(_make_trainer(), TrainCheckpointer(ckdir),
+                                  _init_params, save_every=save_every)
+        try:
+            state = loop.run(batch_fn, total_steps)
+            loop.ckpt.close()
+            return state, restarts
+        except InjectedFault as e:
+            restarts += 1
+            _LOG.info("chaos kill #%d (%s); restarting the loop", restarts, e)
+            try:
+                loop.ckpt.close()
+            except Exception as close_err:
+                # a kill mid-save can leave the manager wedged; a fresh
+                # checkpointer supersedes it on the next restart
+                _LOG.debug("post-kill checkpointer close failed: %s",
+                           close_err)
+            if restarts > max_restarts:
+                raise ChaosError(
+                    f"loop did not complete within {max_restarts} restarts "
+                    "(fault schedule never drains?)") from e
+
+
+def _final_checkpoint_loads(ckdir: str, expect_state: Any,
+                            total_steps: int) -> bool:
+    """A FRESH checkpointer must list the final step and restore it to
+    exactly the in-memory final state — proving no corrupt checkpoint
+    survived the chaos run as the newest step."""
+    from mmlspark_tpu.parallel.checkpoint import TrainCheckpointer
+    ckpt = TrainCheckpointer(ckdir)
+    try:
+        if ckpt.latest_step() != total_steps:
+            _LOG.warning("final checkpoint check: latest_step=%s != %d",
+                         ckpt.latest_step(), total_steps)
+            return False
+        restored = ckpt.restore(_make_trainer(), _init_params)
+        return _bit_identical(restored, expect_state)
+    finally:
+        ckpt.close()
+
+
+def _quarantined(ckdir: str) -> List[str]:
+    try:
+        return sorted(n for n in os.listdir(ckdir)
+                      if n.startswith("corrupt-"))
+    except OSError:
+        return []
+
+
+def _serve_phase(seed: int, requests: int,
+                 errors: List[str]) -> Dict[str, Any]:
+    """Serve traffic under seeded faults; returns phase facts including
+    whether every /healthz poll answered."""
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.serve.http import serve_http
+    from mmlspark_tpu.serve.server import ServeError, Server
+
+    model = JaxModel(inputCol="x", outputCol="y", miniBatchSize=8)
+    model.set_model("mlp_tabular", input_dim=_DIM, hidden=[16],
+                    num_classes=3, seed=seed & 0xFFFF)
+    server = Server({"chaos": model}, max_batch=4, queue_depth=32)
+    httpd, addr = serve_http(server, port=0)
+    http_thread = threading.Thread(target=httpd.serve_forever, daemon=True,
+                                   name="mmlspark-tpu-chaos-http")
+    http_thread.start()
+
+    polls_ok = 0
+    polls_bad = 0
+
+    def poll() -> None:
+        nonlocal polls_ok, polls_bad
+        try:
+            with urllib.request.urlopen(
+                    f"http://{addr}/healthz", timeout=5) as resp:
+                body = json.loads(resp.read().decode())
+                if resp.status == 200 and body.get("status") in (
+                        "ok", "draining"):
+                    polls_ok += 1
+                else:
+                    polls_bad += 1
+        except Exception as e:
+            polls_bad += 1
+            errors.append(f"healthz poll failed: {type(e).__name__}: {e}")
+
+    rng = np.random.default_rng(seed)
+    served = 0
+    injected = 0
+    plan = generate_serve_plan(seed, requests)
+    with plan:
+        for i in range(requests):
+            x = rng.normal(0, 1, (3, _DIM)).astype(np.float32)
+            try:
+                y = server.submit("chaos", x, timeout=30)
+                if np.asarray(y).shape[0] == 3:
+                    served += 1
+                else:
+                    errors.append(f"request {i}: wrong result shape")
+            except (InjectedFault, ServeError):
+                injected += 1  # seeded fault surfacing is the design
+            except Exception as e:
+                errors.append(
+                    f"request {i}: unexpected {type(e).__name__}: {e}")
+            if i % 3 == 0:
+                poll()
+    poll()
+    server.drain(reason="chaos scenario complete")
+    poll()  # the endpoint must answer even after the drain
+    server.close()  # idempotence: second close is a no-op
+    httpd.shutdown()
+    httpd.server_close()
+    if served == 0:
+        errors.append("serve phase completed zero requests")
+    return {"requests": requests, "served": served,
+            "injected_failures": injected, "faults": plan.triggered,
+            "healthz_ok": polls_ok, "healthz_bad": polls_bad}
+
+
+# -- the scenario ------------------------------------------------------------
+
+def run_scenario(seed: int, outdir: str, total_steps: int = 8,
+                 save_every: int = 2, requests: int = 12) -> Dict[str, Any]:
+    """Train-kill-resume-then-serve under a seeded fault schedule; returns
+    (and writes to ``outdir/chaos_verdict.json``) the verdict dict."""
+    os.makedirs(outdir, exist_ok=True)
+    errors: List[str] = []
+    verdict: Dict[str, Any] = {"seed": seed, "total_steps": total_steps,
+                               "save_every": save_every}
+
+    batch_fn = _batch_fn(seed)
+    ref_state, _ = _run_loop_to_completion(
+        os.path.join(outdir, "ref"), batch_fn, total_steps, save_every,
+        max_restarts=0)
+
+    chaos_dir = os.path.join(outdir, "chaos")
+    plan = generate_train_plan(seed, total_steps)
+    bit_identical = False
+    final_loads = False
+    restarts = 0
+    try:
+        with plan:
+            state, restarts = _run_loop_to_completion(
+                chaos_dir, batch_fn, total_steps, save_every,
+                max_restarts=len(plan.specs) + 2)
+        bit_identical = _bit_identical(state, ref_state)
+        final_loads = _final_checkpoint_loads(chaos_dir, state, total_steps)
+    except Exception as e:
+        errors.append(f"train phase: {type(e).__name__}: {e}")
+    verdict["train"] = {"restarts": restarts, "faults": plan.triggered,
+                        "quarantined": _quarantined(chaos_dir)}
+
+    serve_facts: Dict[str, Any] = {}
+    try:
+        serve_facts = _serve_phase(seed, requests, errors)
+    except Exception as e:
+        errors.append(f"serve phase: {type(e).__name__}: {e}")
+    verdict["serve"] = serve_facts
+
+    invariants = {
+        "params_bit_identical": bit_identical,
+        "final_checkpoint_loads": final_loads,
+        "server_stays_live": bool(serve_facts)
+        and serve_facts.get("healthz_bad", 1) == 0
+        and serve_facts.get("healthz_ok", 0) > 0,
+        "no_unhandled_exceptions": not errors,
+    }
+    verdict["invariants"] = invariants
+    verdict["errors"] = errors
+    verdict["passed"] = all(invariants.values())
+
+    path = os.path.join(outdir, VERDICT_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(verdict, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    _LOG.info("chaos verdict (%s): %s", path,
+              "PASS" if verdict["passed"] else "FAIL")
+    return verdict

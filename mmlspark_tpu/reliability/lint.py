@@ -29,6 +29,13 @@ the whole stream in host memory instead of blocking. Every library-code
 queue states its bound; a deliberate unbounded queue writes ``maxsize=0``
 so the choice is greppable.
 
+Rule 6 — ``signal.signal(...)`` outside ``reliability/preemption.py``:
+signal handlers are PROCESS-GLOBAL and last-installer-wins, so a handler
+registered in some corner of the library silently clobbers the
+preemption layer's SIGTERM->clean-checkpoint path. All handler
+installation goes through ``reliability.preemption``; intentional
+exceptions mark the line ``# lint: allow-signal``.
+
 Shared core for ``tools/check_reliability.py`` (standalone CLI),
 ``mmlspark-tpu check`` (installed CLI), and the in-pytest gate
 (tests/test_reliability_lint.py) — same single source of truth pattern as
@@ -72,6 +79,19 @@ def _catches_everything(node: ast.expr) -> bool:
 
 
 _ALLOW_PRINT = "# lint: allow-print"
+_ALLOW_SIGNAL = "# lint: allow-signal"
+# the ONE module allowed to install process-global signal handlers
+_SIGNAL_HOME = "reliability/preemption.py"
+
+
+def _is_signal_signal(call: ast.Call) -> bool:
+    """``signal.signal(...)`` (or any ``<x>.signal(...)`` attribute call on
+    a name ending in ``signal``) — the handler-installation form. A bare
+    ``signal(...)`` name call is NOT flagged: that's someone's local
+    function, not the stdlib installer."""
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "signal"
+            and isinstance(f.value, ast.Name) and f.value.id == "signal")
 
 
 def check_source(src: str, filename: str = "<src>") -> List[str]:
@@ -79,11 +99,16 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     problems: List[str] = []
     tree = ast.parse(src, filename=filename)
     lines = src.splitlines()
+    signal_home = str(filename).replace("\\", "/").endswith(_SIGNAL_HOME)
 
     def _allowed(lineno: int) -> bool:
         # marker anywhere on the offending line opts that line out
         return (0 < lineno <= len(lines)
                 and _ALLOW_PRINT in lines[lineno - 1])
+
+    def _signal_allowed(lineno: int) -> bool:
+        return (0 < lineno <= len(lines)
+                and _ALLOW_SIGNAL in lines[lineno - 1])
 
     for node in ast.walk(tree):
         if (isinstance(node, ast.Call)
@@ -123,6 +148,15 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
                 problems.append(
                     f"{filename}:{node.lineno}: urlopen() without timeout= "
                     "(a stalled connection hangs forever)")
+        elif (isinstance(node, ast.Call) and _is_signal_signal(node)
+                and not signal_home
+                and not _signal_allowed(node.lineno)):
+            problems.append(
+                f"{filename}:{node.lineno}: signal.signal() outside "
+                f"{_SIGNAL_HOME} (handlers are process-global and "
+                "last-installer-wins; route through "
+                "reliability.preemption, or mark the line "
+                f"`{_ALLOW_SIGNAL}`)")
         elif isinstance(node, ast.ExceptHandler):
             if node.type is None:
                 problems.append(

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from mmlspark_tpu.reliability import preemption
 from mmlspark_tpu.utils.logging import get_logger
 
 _LOG = get_logger("reliability.resilient")
@@ -97,6 +98,8 @@ class ResilientTrainLoop:
         if start >= total_steps:
             return state
         for step in range(start + 1, total_steps + 1):
+            if preemption.preempted():
+                return self._drain(state, step - 1)
             batch = self.trainer.put_batch(batch_fn(step))
             state, _metrics = self.trainer.train_step(state, batch, rng)
             self.ckpt.maybe_save(state, every=self.save_every, step=step)
@@ -105,6 +108,25 @@ class ResilientTrainLoop:
         self.ckpt.wait()
         if self.ckpt.latest_step() != total_steps:
             self.ckpt.save(state, step=total_steps, wait=True)
+        return state
+
+    def _drain(self, state: Any, step: int, data_state: Any = None) -> Any:
+        """Preemption exit: force a synchronous final checkpoint (plus the
+        input-pipeline sidecar when streaming) so the next run resumes from
+        THIS step instead of the last cadence-aligned save."""
+        reason = preemption.preemption_reason() or "preempted"
+        _LOG.warning("preempted (%s) at step %d: committing a final "
+                     "checkpoint before exit", reason, step)
+        self.ckpt.wait()
+        if step > 0 and self.ckpt.latest_step() != step:
+            if data_state is not None:
+                self.ckpt.put_data_state(step, data_state)
+            self.ckpt.save(state, step=step, wait=True)
+        from mmlspark_tpu.observability import events, metrics
+        metrics.counter("reliability.preemption_drains").inc()
+        if events.events_enabled():
+            events.emit("event", "preemption.drain", step=step,
+                        reason=reason, kind="train")
         return state
 
     def run_dataset(self, data, total_steps: int,
@@ -141,6 +163,9 @@ class ResilientTrainLoop:
             if start >= total_steps:
                 return state
             for step in range(start + 1, total_steps + 1):
+                if preemption.preempted():
+                    return self._drain(state, step - 1,
+                                       data_state=it.state_dict())
                 try:
                     host = next(it)
                 except StopIteration:

@@ -88,6 +88,19 @@ class Attempt:
         if self.number >= p.max_attempts or not p.retryable(exc):
             return False
         delay = p.delay(self.number)
+        # Retry-After protocol: an exception carrying a positive numeric
+        # ``retry_after`` (seconds — HttpRepo parses the 429/503 header
+        # into it, CircuitOpen sets retry_in_s) RAISES the delay to the
+        # server's ask; the deadline check below still caps the total, so
+        # an absurd header gives up rather than oversleeping the budget.
+        hinted = getattr(exc, "retry_after", None)
+        if hinted is None:
+            hinted = getattr(exc, "retry_in_s", None)
+        try:
+            if hinted is not None and float(hinted) > delay:
+                delay = float(hinted)
+        except (TypeError, ValueError):
+            pass
         if p.deadline is not None and \
                 (p.clock() - self._started) + delay > p.deadline:
             _LOG.warning(

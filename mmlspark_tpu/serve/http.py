@@ -31,7 +31,7 @@ import numpy as np
 
 from mmlspark_tpu.observability import metrics
 from mmlspark_tpu.serve.server import (
-    RequestExpired, ServeError, Server, ServerOverloaded,
+    RequestExpired, ServeError, Server, ServerClosed, ServerOverloaded,
 )
 from mmlspark_tpu.utils.logging import get_logger
 
@@ -63,7 +63,12 @@ def make_handler(server: Server):
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._reply(200, {"status": "ok", "stats": server.stats()})
+                # a draining server is still LIVE (in-flight work finishes,
+                # /healthz answers) but no longer ready for new traffic —
+                # load balancers read "draining" and rotate it out
+                status = "draining" if server.draining else "ok"
+                self._reply(200, {"status": status,
+                                  "stats": server.stats()})
             elif self.path == "/models":
                 self._reply(200, {"models": server.registry.names()})
             elif self.path == "/metrics":
@@ -100,8 +105,14 @@ def make_handler(server: Server):
                 else:
                     y = server.submit_many(model, x, deadline_ms)
             except ServerOverloaded as e:
+                # Retry-After: 1 while draining (this replica is going
+                # away — come back to the pool, not instantly to us)
+                after = "1" if server.draining else "0"
                 self._reply(503, {"error": str(e), "retryable": True},
-                            headers={"Retry-After": "0"})
+                            headers={"Retry-After": after})
+            except ServerClosed as e:
+                self._reply(503, {"error": str(e), "retryable": True},
+                            headers={"Retry-After": "1"})
             except RequestExpired as e:
                 self._reply(504, {"error": str(e)})
             except (KeyError, ValueError) as e:

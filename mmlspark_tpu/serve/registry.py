@@ -29,6 +29,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from mmlspark_tpu.reliability.breaker import CircuitBreaker
 from mmlspark_tpu.utils import config as mmlconfig
 
 
@@ -49,6 +50,10 @@ class ModelEntry:
         self._apply = None
         self._compiled: Dict[Tuple, Callable] = {}
         self.compile_count = 0
+        # per-model breaker: a model whose program keeps dying (OOM, bad
+        # params after a hot-swap) fails FAST instead of burning executor
+        # time per batch; other models on the same server keep serving
+        self.breaker = CircuitBreaker(f"serve.{name}")
 
     # -- warm-up ----------------------------------------------------------
     def ensure_apply(self):
@@ -106,7 +111,13 @@ class ModelEntry:
         return prog
 
     def score(self, x: np.ndarray) -> np.ndarray:
-        """Score one padded bucket-shaped batch -> host float32 rows."""
+        """Score one padded bucket-shaped batch -> host float32 rows.
+        Runs through the per-model circuit breaker: repeated failures trip
+        it open and subsequent batches for THIS model fail immediately
+        (``CircuitOpen``, retryable) until the half-open probe succeeds."""
+        return self.breaker.call(self._score, x)
+
+    def _score(self, x: np.ndarray) -> np.ndarray:
         out = np.asarray(self.program_for(x.shape[0], x)(x))
         if out.ndim == 1:
             out = out[:, None]

@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from mmlspark_tpu.observability import events, metrics
+from mmlspark_tpu.reliability import watchdog as _watchdog
 from mmlspark_tpu.reliability.faults import fault_site
 from mmlspark_tpu.serve.batcher import (
     MicroBatcher, Ticket, bucket_for, default_buckets, parse_buckets,
@@ -116,7 +117,13 @@ class Server:
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._batcher = MicroBatcher(self.max_batch, self.max_wait_s,
                                      clock=self.clock)
+        # _admit serializes the admission-state check against the enqueue
+        # AND against close()/drain() flipping that state: without it a
+        # ticket could pass the check, lose the CPU, and be enqueued after
+        # the executor drained — a future nobody will ever resolve.
+        self._admit = threading.Lock()
         self._closed = False
+        self._draining = False
         self._thread: Optional[threading.Thread] = None
         # counters are unconditional (lock + int add); gauges/histograms
         # gate per-use on metrics_enabled()
@@ -135,18 +142,29 @@ class Server:
             target=self._run, name="mmlspark-tpu-serve", daemon=True)
         self._thread.start()
 
-    def close(self, drain: bool = True) -> None:
+    def close(self, drain: bool = True,
+              timeout_s: Optional[float] = None) -> None:
         """Stop the executor. ``drain=True`` scores everything already
-        admitted first; ``drain=False`` fails pending work with
-        :class:`ServerClosed`."""
-        if self._closed:
-            return
-        self._closed = True
+        admitted first; ``drain=False`` fails pending work with a
+        retryable :class:`ServerOverloaded` (shed to another replica, not
+        a hang). Idempotent and race-safe: the second call is a no-op,
+        and the admission lock guarantees no ticket slips into the queue
+        after the executor stops — every admitted future resolves.
+        ``timeout_s`` bounds the executor join (default
+        ``serving.drain_timeout_s``)."""
+        with self._admit:
+            if self._closed:
+                return
+            self._closed = True
+            self._draining = True
+        if timeout_s is None:
+            timeout_s = float(mmlconfig.get("serving.drain_timeout_s"))
         if self._thread is not None:
             self._queue.put(_STOP)
-            self._thread.join(timeout=60.0)
+            self._thread.join(timeout=max(timeout_s, 0.1))
             if self._thread.is_alive():
-                logger.warning("serve executor did not stop within 60s")
+                logger.warning("serve executor did not stop within %.1fs",
+                               timeout_s)
             self._thread = None
         leftovers = [t for t in self._drain_tickets() if t is not _STOP]
         if drain:
@@ -158,10 +176,38 @@ class Server:
             while len(self._batcher):
                 leftovers.extend(self._batcher.take())
             for t in leftovers:
-                t.future.set_exception(ServerClosed("server closed"))
+                if not t.future.done():
+                    t.future.set_exception(ServerOverloaded(
+                        "server closed before scoring; retry elsewhere"))
         if events.events_enabled():
             s = self.stats()
             events.emit("serving", "summary", **s)
+
+    def drain(self, timeout_s: Optional[float] = None,
+              reason: str = "drain") -> None:
+        """Graceful shutdown for preemption: stop admission FIRST (new
+        submits shed with retryable :class:`ServerOverloaded`, the HTTP
+        front-end maps that to 503 + ``Retry-After``), finish everything
+        already admitted, then close. ``timeout_s`` defaults to
+        ``serving.drain_timeout_s``. Idempotent."""
+        with self._admit:
+            if self._closed:
+                return
+            already = self._draining
+            self._draining = True
+        if not already:
+            logger.warning("serve: draining (%s); admission stopped", reason)
+            metrics.counter("serving.drains").inc()
+            if events.events_enabled():
+                events.emit("event", "preemption.drain", kind="serve",
+                            reason=reason,
+                            pending=self._queue.qsize() +
+                            len(self._batcher))
+        self.close(drain=True, timeout_s=timeout_s)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining and not self._closed
 
     def __enter__(self) -> "Server":
         return self
@@ -178,6 +224,8 @@ class Server:
         synchronously when the queue is full."""
         if self._closed:
             raise ServerClosed("server closed")
+        if self._draining:
+            raise ServerOverloaded("server draining; retry elsewhere")
         entry = self.registry.get(model)   # KeyError surfaces here, early
         arr = np.asarray(x)
         if arr.ndim == 1:
@@ -197,7 +245,17 @@ class Server:
         fault_site("serve.enqueue", {"model": model,
                                      "rows": ticket.rows})
         try:
-            self._queue.put_nowait(ticket)
+            # check-and-enqueue is atomic against close()/drain(): a
+            # ticket is either in the queue BEFORE the stop sentinel (the
+            # executor or close() resolves it) or rejected here — never
+            # admitted into a stopped server.
+            with self._admit:
+                if self._closed:
+                    raise ServerClosed("server closed")
+                if self._draining:
+                    raise ServerOverloaded(
+                        "server draining; retry elsewhere")
+                self._queue.put_nowait(ticket)
         except queue.Full:
             self._shed.inc()
             if events.events_enabled():
@@ -233,9 +291,21 @@ class Server:
 
     # -- executor ----------------------------------------------------------
     def _run(self) -> None:
+        # liveness: the executor beats once per loop pass; the idle wait
+        # is bounded (never a blocking get(None)) so an EMPTY server still
+        # beats and only a wedged flush reads as a stall
+        hb = _watchdog.register("serve.executor")
+        try:
+            self._run_loop(hb)
+        finally:
+            hb.close()
+
+    def _run_loop(self, hb) -> None:
         stopping = False
         while True:
+            hb.beat()
             wait = self._batcher.wait_s()
+            wait = 0.5 if wait is None else min(wait, 0.5)
             try:
                 item = self._queue.get(timeout=wait)
             except queue.Empty:

@@ -40,6 +40,11 @@ _DEFAULTS: Dict[str, Any] = {
     "reliability.http_timeout": 30.0,  # seconds per urlopen (downloader)
     "reliability.max_attempts": 3,     # default RetryPolicy attempt cap
     "reliability.base_delay": 0.2,     # first backoff delay (seconds)
+    # liveness layer (watchdog / circuit breakers; see docs/RELIABILITY.md)
+    "reliability.stall_timeout_s": 0.0,   # 0 = watchdog stall detection off
+    "reliability.watchdog_poll_s": 1.0,   # monitor thread poll cadence
+    "reliability.breaker_failures": 5,    # consecutive failures -> open
+    "reliability.breaker_reset_s": 30.0,  # open -> half-open probe delay
     # serving (dynamic micro-batching inference server; serve/ package)
     "serving.max_batch": 64,          # rows per flushed micro-batch
     "serving.max_wait_ms": 5.0,       # max coalescing wait before flush
@@ -48,6 +53,7 @@ _DEFAULTS: Dict[str, Any] = {
     "serving.buckets": "",            # "" = {1, max/8, max/2, max}; else
                                       # e.g. "1,8,64" (largest >= max_batch)
     "serving.default_deadline_ms": 0.0,  # 0 = requests never expire
+    "serving.drain_timeout_s": 10.0,  # graceful-drain budget before close
     # logging
     "logging.level": "INFO",
     "logging.metrics_every": 0,       # default train-metric log cadence (steps)

@@ -200,6 +200,45 @@ def test_accepts_queue_with_explicit_maxsize():
     """) == []
 
 
+def test_flags_signal_signal_outside_preemption_module():
+    probs = _problems("""
+        import signal
+
+        def install():
+            signal.signal(signal.SIGTERM, lambda *a: None)
+    """)
+    assert len(probs) == 1 and "signal.signal" in probs[0]
+    assert "reliability/preemption.py" in probs[0]
+    assert "mod.py:5" in probs[0]
+
+
+def test_accepts_signal_signal_in_its_home_module():
+    src = textwrap.dedent("""
+        import signal
+
+        def install():
+            signal.signal(signal.SIGTERM, lambda *a: None)
+    """)
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/reliability/preemption.py") == []
+    # path-suffix match survives absolute paths and Windows separators
+    assert lint.check_source(
+        src, filename="C:\\x\\mmlspark_tpu\\reliability\\preemption.py") == []
+
+
+def test_accepts_signal_signal_with_marker_and_non_installer_calls():
+    assert _problems("""
+        import signal
+
+        def install():
+            signal.signal(signal.SIGUSR1, h)  # lint: allow-signal
+
+        def not_the_installer(sig):
+            signal(sig)              # a local callable named `signal`
+            return signal.getsignal(sig)
+    """) == []
+
+
 def test_syntax_error_is_reported_not_crashing(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text("def broken(:\n")
