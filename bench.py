@@ -1441,6 +1441,10 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default=",".join(CONFIGS),
                     help="comma list of: " + ",".join(CONFIGS))
+    ap.add_argument("--baseline", default="",
+                    help="committed bench JSON (raw line or BENCH_rNN.json "
+                    "wrapper) to gate against; verdict printed as a second "
+                    "JSON line, exit nonzero on regression")
     args = ap.parse_args()
     names = list(dict.fromkeys(  # dedupe, order-preserving: a duplicate
         c.strip() for c in args.configs.split(",") if c.strip()))
@@ -1553,6 +1557,13 @@ def main() -> int:
     print(json.dumps(line))
     if terminated:
         return 3  # partial results: the line is honest but incomplete
+    if args.baseline:
+        from mmlspark_tpu.observability import benchgate
+        verdict = benchgate.gate(line, args.baseline)
+        print(json.dumps(verdict))
+        if not verdict["green"]:
+            return 2  # regression gate: at least one lane went red
+    return 0
 
 
 if __name__ == "__main__":

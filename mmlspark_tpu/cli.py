@@ -265,9 +265,22 @@ def cmd_check(args, passthrough) -> int:
 
 
 def cmd_report(args, passthrough) -> int:
-    """Render a run report from a telemetry event log (JSONL)."""
-    from mmlspark_tpu.observability.report import render_report
-    print(render_report(args.events, top=args.top))  # lint: allow-print
+    """Render a run report from a telemetry event log (JSONL); --json for
+    the structured form, --trace to also export a Chrome-trace/Perfetto
+    timeline of the same log."""
+    if getattr(args, "trace", None):
+        from mmlspark_tpu.observability.trace import export_trace
+        stats = export_trace(args.events, args.trace)
+        print(f"trace: {stats['out']} ({stats['spans']} spans, "  # lint: allow-print
+              f"{stats['events']} events, {stats['tracks']} tracks) — "
+              "open in https://ui.perfetto.dev")
+    if getattr(args, "json", False):
+        from mmlspark_tpu.observability.report import build_report
+        print(json.dumps(build_report(args.events, top=args.top),  # lint: allow-print
+                         sort_keys=True))
+    else:
+        from mmlspark_tpu.observability.report import render_report
+        print(render_report(args.events, top=args.top))  # lint: allow-print
     return 0
 
 
@@ -377,7 +390,9 @@ def cmd_bench(args, passthrough) -> int:
     if not os.path.exists(path):
         raise SystemExit("no bench.py in the current directory")
     saved_argv = sys.argv
-    sys.argv = [path] + passthrough
+    extra = ["--baseline", args.baseline] if getattr(args, "baseline", "") \
+        else []
+    sys.argv = [path] + extra + passthrough
     try:
         runpy.run_path(path, run_name="__main__")
     finally:
@@ -443,6 +458,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     info_p.set_defaults(fn=cmd_info)
 
     bench_p = sub.add_parser("bench", help="run ./bench.py")
+    bench_p.add_argument("--baseline", default="",
+                         help="committed bench JSON (e.g. BENCH_r05.json) "
+                         "to gate against: per-lane regression thresholds, "
+                         "verdict on stdout, exit nonzero on red")
     bench_p.set_defaults(fn=cmd_bench)
 
     check_p = sub.add_parser(
@@ -499,10 +518,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "with observability.events_path set")
     report_p.add_argument("--top", type=int, default=10,
                           help="rows in the slowest-span table (default 10)")
+    report_p.add_argument("--trace", default="",
+                          help="also export a Chrome-trace/Perfetto JSON "
+                          "timeline to this path")
+    report_p.add_argument("--json", action="store_true",
+                          help="emit the structured report as one JSON "
+                          "object instead of text")
     report_p.set_defaults(fn=cmd_report)
 
     args = parser.parse_args(argv)
-    return args.fn(args, passthrough)
+    try:
+        return args.fn(args, passthrough)
+    except Exception:
+        # last-gasp: persist the flight recorder so the crash ships its
+        # own context even when observability.events_path was never set
+        try:
+            from mmlspark_tpu.observability import flightrec
+            dumped = flightrec.dump(reason="crash")
+            if dumped:
+                print(f"flight recorder dumped to {dumped}",  # lint: allow-print
+                      file=sys.stderr)
+        except (ImportError, OSError):  # dump() itself never raises
+            dumped = None
+        raise
 
 
 if __name__ == "__main__":

@@ -130,7 +130,9 @@ def _device_confusion(y, pred, k: int) -> np.ndarray:
     import jax.numpy as jnp
     out = _device_confusion_jit()(jnp.asarray(y, np.int32),
                                   jnp.asarray(pred, np.int32), int(k))
-    return np.asarray(jax.device_get(out)).astype(np.int64)
+    from mmlspark_tpu.observability import syncs
+    return np.asarray(
+        syncs.device_get(out, "evaluate.confusion")).astype(np.int64)
 
 
 @functools.lru_cache(maxsize=1)
@@ -186,7 +188,10 @@ def _device_auc_aucpr(y, scores) -> Tuple[float, float]:
     import jax.numpy as jnp
     a, pr = _device_auc_jit()(jnp.asarray(np.asarray(y, np.int32)),
                               jnp.asarray(np.asarray(scores, np.float32)))
-    return float(jax.device_get(a)), float(jax.device_get(pr))
+    from mmlspark_tpu.observability import syncs
+    # one counted sync: (a, pr) fetched together, not two round trips
+    a, pr = syncs.device_get((a, pr), "evaluate.auc")
+    return float(a), float(pr)
 
 
 def binary_accuracy_precision_recall(cm: np.ndarray) -> Tuple[float, float, float]:

@@ -31,6 +31,7 @@ from mmlspark_tpu.core.pipeline import Model
 from mmlspark_tpu.core.schema import ColumnSchema, DType, SchemaError
 from mmlspark_tpu.core.serialization import register_stage
 from mmlspark_tpu.models.zoo import build_model
+from mmlspark_tpu.observability import syncs as obssyncs
 
 
 @register_stage
@@ -428,7 +429,8 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
                 return
             stacked = dev_outs[0] if len(dev_outs) == 1 \
                 else jnp.concatenate(dev_outs, axis=0)
-            outs.append(np.asarray(jax.device_get(stacked)))
+            outs.append(np.asarray(
+                obssyncs.device_get(stacked, "transform.retire")))
             dev_outs.clear()
 
         def flush():
@@ -440,7 +442,8 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
                 if len(dev_outs) >= window:
                     retire()
                 elif len(dev_outs) >= in_flight:
-                    dev_outs[-in_flight].block_until_ready()
+                    obssyncs.block_until_ready(
+                        dev_outs[-in_flight], "transform.backpressure")
             pending.clear()
 
         for batch in frame.batches(bs, cols=[self.inputCol]):
@@ -499,7 +502,7 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         of the last batch, so one flat slice drops them."""
         n_total = frame.count()
         out = apply_stack(dev)                      # (steps, bs, ...)
-        out = np.asarray(jax.device_get(out))
+        out = np.asarray(obssyncs.device_get(out, "transform.resident"))
         out = out.reshape((out.shape[0] * out.shape[1],) + out.shape[2:])
         return self._emit(frame, [out[:n_total]])
 
@@ -518,7 +521,8 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
                 return
             stacked = dev_outs[0] if len(dev_outs) == 1 \
                 else jnp.concatenate(dev_outs, axis=0)
-            outs.append(np.asarray(jax.device_get(stacked)))
+            outs.append(np.asarray(
+                obssyncs.device_get(stacked, "transform.retire")))
             dev_outs.clear()
 
         for i in range(dev.shape[0]):
@@ -527,7 +531,8 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
             if len(dev_outs) >= window:
                 retire()
             elif len(dev_outs) >= in_flight:
-                dev_outs[-in_flight].block_until_ready()
+                obssyncs.block_until_ready(
+                    dev_outs[-in_flight], "transform.backpressure")
         retire()
         return self._emit(frame, outs)
 
@@ -578,7 +583,8 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         def retire(down_to: int) -> None:
             while len(pending) > down_to:
                 out, n = pending.pop(0)
-                outs.append(np.asarray(jax.device_get(out))[:n])
+                outs.append(np.asarray(
+                    obssyncs.device_get(out, "transform.sharded"))[:n])
 
         # sequence dim (tokens are (B, L)) shards over `seq` only for
         # architectures that OPTED INTO seq-parallel attention — for

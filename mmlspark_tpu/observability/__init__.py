@@ -1,8 +1,8 @@
-"""Unified telemetry: spans, event log, metrics registry, run reports.
+"""Unified telemetry: spans, event log, metrics, traces, run reports.
 
 The single layer the whole stack reports through (SURVEY.md §5 sets the
 observability bar above the reference, which had nothing beyond test
-wall-clock timing). Three pieces, one pipeline:
+wall-clock timing). The pieces, one pipeline:
 
 - :mod:`events` — a process-wide JSON-lines event log with an injectable
   clock (tests are deterministic) — off until ``observability.events_path``
@@ -11,19 +11,35 @@ wall-clock timing). Three pieces, one pipeline:
   context-propagated parent stack; each span emits one structured event on
   exit and can pass through a ``jax.profiler.TraceAnnotation``
   (``observability.annotate``);
-- :mod:`metrics` — counters / gauges / fixed-bucket histograms with
-  Prometheus text exposition and a JSON dump.
+- :mod:`metrics` — counters / gauges / fixed-bucket histograms (with
+  trace-id exemplars) plus Prometheus text exposition and a JSON dump;
+- :mod:`syncs` — the host-sync accounter: every
+  ``device_get``/``block_until_ready`` goes through :func:`sync_point`
+  so "syncs per step" is a measured number, not a slogan (lint Rule 7
+  enforces the routing);
+- :mod:`flightrec` — a bounded in-memory ring of the last N events, ON
+  by default, dumped on watchdog stalls / chaos red verdicts / CLI
+  crashes so incidents ship a timeline even with the event log off;
+- :mod:`trace` — Chrome-trace/Perfetto export of a captured log
+  (``mmlspark-tpu report ... --trace out.trace.json``);
+- :mod:`benchgate` — the bench regression gate
+  (``mmlspark-tpu bench --baseline BENCH_rNN.json``).
 
-Everything is off by default and near-zero-cost when disabled: ``span()``
-short-circuits to a shared no-op before any string work, ``emit()`` returns
-before serializing, and hot loops gate per-step collection on
-``observability.metrics``. ``mmlspark-tpu report <events.jsonl>``
-(:mod:`report`) renders the wall-time breakdown from a captured log.
+Everything is near-zero-cost when disabled: ``span()`` short-circuits to
+a shared no-op before any string work, ``emit()`` returns before
+serializing when no sink is live, and hot loops gate per-step collection
+on ``observability.metrics``. The flight recorder is the one default-on
+sink — an in-memory deque append, no I/O (set
+``observability.flight_recorder_size`` to 0 for the true-zero path).
+``mmlspark-tpu report <events.jsonl>`` (:mod:`report`) renders the
+wall-time breakdown from a captured log (``--json`` for the structured
+form).
 """
 from mmlspark_tpu.observability.events import (  # noqa: F401
     emit,
     events_enabled,
     perf,
+    recording_enabled,
     reset_clock,
     set_clock,
     wall,
@@ -31,9 +47,11 @@ from mmlspark_tpu.observability.events import (  # noqa: F401
 from mmlspark_tpu.observability.metrics import (  # noqa: F401
     MetricsRegistry,
     counter,
+    escape_label_value,
     gauge,
     get_registry,
     histogram,
     metrics_enabled,
 )
 from mmlspark_tpu.observability.spans import span  # noqa: F401
+from mmlspark_tpu.observability.syncs import sync_point  # noqa: F401

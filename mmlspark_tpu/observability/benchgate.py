@@ -1,0 +1,135 @@
+"""Bench regression gate: fresh bench line vs a committed baseline.
+
+``mmlspark-tpu bench --baseline BENCH_r05.json`` (or ``./bench.py
+--baseline ...``) runs the bench as usual, then compares the fresh
+one-line JSON result against the committed baseline per lane:
+
+- ``value`` (the lane's headline throughput) must not drop more than the
+  tolerance below the baseline;
+- ``step_ms`` must not rise more than the tolerance above it;
+- ``mfu`` must not drop more than the tolerance below it.
+
+A lane that was budget-skipped (or terminated) in EITHER run is marked
+``skipped``, never red — congestion on the bench host must not fail CI.
+A lane missing a field in the baseline simply skips that check. The
+verdict is printed as a second JSON line on stdout and the process exits
+0 iff every checked lane is green.
+
+Baselines are accepted in both shapes the repo produces: the raw bench
+line (``{"metric", "value", "configs": {...}}``) and the driver wrapper
+committed as BENCH_r05.json (``{"n", "cmd", "rc", "parsed": <line>}``).
+
+Pure data in, data out — no jax, no bench imports — so the comparison is
+unit-testable without running a single bench step.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+# 10%: wide enough to ride out shared-host noise on a 5-rep bench, tight
+# enough to catch the 20%+ cliffs a bad dispatch-path change causes.
+DEFAULT_TOLERANCE = 0.10
+
+
+def load_baseline(path: str) -> Dict[str, Any]:
+    """Read a committed baseline; unwraps the ``{"parsed": ...}`` driver
+    wrapper when present. Raises ValueError when no bench line with a
+    ``configs`` map can be found."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict) and isinstance(data.get("parsed"), dict):
+        data = data["parsed"]
+    if not isinstance(data, dict) or not isinstance(data.get("configs"),
+                                                    dict):
+        raise ValueError(
+            f"{path}: not a bench baseline (expected a bench line with a "
+            "'configs' map, or a wrapper with 'parsed')")
+    return data
+
+
+def _num(lane: Dict[str, Any], field: str) -> Optional[float]:
+    v = lane.get(field)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def _check(name: str, fresh_v: Optional[float], base_v: Optional[float],
+           tolerance: float, higher_is_better: bool) -> Optional[Dict[str, Any]]:
+    """One metric comparison; None when either side can't be checked
+    (missing field, or a zero/negative baseline that makes a ratio
+    meaningless)."""
+    if fresh_v is None or base_v is None or base_v <= 0:
+        return None
+    ratio = fresh_v / base_v
+    if higher_is_better:
+        ok = ratio >= 1.0 - tolerance
+    else:
+        ok = ratio <= 1.0 + tolerance
+    return {"metric": name, "fresh": fresh_v, "baseline": base_v,
+            "ratio": round(ratio, 4), "tolerance": tolerance, "ok": ok}
+
+
+def compare(fresh: Dict[str, Any], baseline: Dict[str, Any],
+            tolerance: float = DEFAULT_TOLERANCE) -> Dict[str, Any]:
+    """Per-lane comparison of a fresh bench line against a baseline line.
+
+    Returns the verdict dict: ``{"gate": ..., "green": bool, "lanes":
+    {name: {"status": green|red|skipped, "checks": [...], "reasons":
+    [...]}}, "red": [...], "skipped": [...]}``.
+    """
+    fresh_cfg = fresh.get("configs") or {}
+    base_cfg = baseline.get("configs") or {}
+    lanes: Dict[str, Any] = {}
+    red, skipped = [], []
+    for name in sorted(base_cfg):
+        base_lane = base_cfg.get(name) or {}
+        fresh_lane = fresh_cfg.get(name)
+        if base_lane.get("skipped"):
+            lanes[name] = {"status": "skipped",
+                           "reasons": ["skipped in baseline"]}
+            skipped.append(name)
+            continue
+        if fresh_lane is None or fresh_lane.get("skipped"):
+            reason = (fresh_lane or {}).get("reason", "lane did not run")
+            lanes[name] = {"status": "skipped", "reasons": [str(reason)]}
+            skipped.append(name)
+            continue
+        checks = [c for c in (
+            _check("value", _num(fresh_lane, "value"),
+                   _num(base_lane, "value"), tolerance, True),
+            _check("step_ms", _num(fresh_lane, "step_ms"),
+                   _num(base_lane, "step_ms"), tolerance, False),
+            _check("mfu", _num(fresh_lane, "mfu"),
+                   _num(base_lane, "mfu"), tolerance, True),
+        ) if c is not None]
+        reasons = [
+            f"{c['metric']}: {c['fresh']:g} vs baseline "
+            f"{c['baseline']:g} (ratio {c['ratio']:g}, "
+            f"tolerance {c['tolerance']:g})"
+            for c in checks if not c["ok"]]
+        status = "red" if reasons else "green"
+        if reasons:
+            red.append(name)
+        lanes[name] = {"status": status, "checks": checks,
+                       "reasons": reasons}
+    # lanes only in the fresh run have nothing to regress against
+    for name in sorted(set(fresh_cfg) - set(base_cfg)):
+        lanes[name] = {"status": "skipped",
+                       "reasons": ["no baseline lane"]}
+        skipped.append(name)
+    return {"gate": "bench-regression", "tolerance": tolerance,
+            "green": not red, "red": red, "skipped": skipped,
+            "lanes": lanes}
+
+
+def gate(fresh: Dict[str, Any], baseline_path: str,
+         tolerance: float = DEFAULT_TOLERANCE) -> Dict[str, Any]:
+    """Load the baseline, compare, and return the verdict with the
+    baseline path recorded (the bench CLI prints this as its second
+    stdout line and exits nonzero unless ``verdict["green"]``)."""
+    verdict = compare(fresh, load_baseline(baseline_path),
+                      tolerance=tolerance)
+    verdict["baseline"] = baseline_path
+    return verdict

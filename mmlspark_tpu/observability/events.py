@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from mmlspark_tpu.observability import flightrec
 from mmlspark_tpu.utils import config
 
 _lock = threading.Lock()
@@ -67,21 +68,40 @@ def events_enabled() -> bool:
     return bool(config.get("observability.events_path"))
 
 
+def recording_enabled() -> bool:
+    """Is ANY event sink live — the JSONL log or the in-memory flight
+    recorder (:mod:`flightrec`, on by default)? Cold/incident paths gate
+    on this so post-mortem timelines exist even in runs that never set
+    ``observability.events_path``; per-step hot paths keep gating on
+    :func:`events_enabled`."""
+    return bool(config.get("observability.events_path")) \
+        or flightrec.active()
+
+
 def events_path() -> str:
     return config.get("observability.events_path")
 
 
 def emit(etype: str, name: str, **fields: Any) -> None:
-    """Append one event line; a silent no-op when the log is off.
+    """Append one event line; also feeds the flight-recorder ring
+    (:mod:`flightrec`) when it is on. A silent no-op when both sinks are
+    off.
 
     ``fields`` must be JSON-representable; anything else falls back to
     ``str()`` rather than killing the instrumented caller.
     """
     path = config.get("observability.events_path")
-    if not path:
+    ring = flightrec.active()
+    if not (path or ring):
         return
     event = {"ts": round(wall(), 6), "type": etype, "name": name}
     event.update(fields)
+    if ring:
+        # the ring stores the dict (serialization deferred to dump time);
+        # emit never mutates `event` after this point
+        flightrec.record(event)
+    if not path:
+        return
     line = json.dumps(event, sort_keys=True, default=str)
     global _writer_path, _writer_fh
     with _lock:

@@ -49,6 +49,15 @@ def sanitize(name: str) -> str:
     return name
 
 
+def escape_label_value(value: str) -> str:
+    """Escape a label value per the Prometheus text exposition format:
+    backslash, double-quote, and newline must be escaped inside the
+    ``label="..."`` quotes or the line is unparsable (a trace_id or model
+    name with a quote would corrupt the whole /metrics page)."""
+    return (str(value).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
 class Counter:
     __slots__ = ("name", "_lock", "_value")
 
@@ -92,7 +101,8 @@ class Gauge:
 class Histogram:
     """Cumulative fixed-bucket histogram (Prometheus ``le`` semantics)."""
 
-    __slots__ = ("name", "buckets", "_lock", "_counts", "_sum", "_count")
+    __slots__ = ("name", "buckets", "_lock", "_counts", "_sum", "_count",
+                 "_exemplar")
 
     def __init__(self, name: str, buckets: Sequence[float] = DEFAULT_BUCKETS):
         if not buckets or list(buckets) != sorted(buckets):
@@ -104,13 +114,27 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf overflow
         self._sum = 0.0
         self._count = 0
+        self._exemplar: Optional[Dict[str, Any]] = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         i = bisect.bisect_left(self.buckets, v)
         with self._lock:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar is not None:
+                # last-exemplar-wins: one correlating id (a serve
+                # trace_id) per histogram is enough to jump from a bad
+                # latency to the exact request timeline
+                self._exemplar = {"trace_id": str(exemplar),
+                                  "value": float(v)}
+
+    @property
+    def exemplar(self) -> Optional[Dict[str, Any]]:
+        """``{"trace_id": ..., "value": ...}`` of the most recent observe
+        that carried one (tail sampling records slow-request trace_ids
+        here), or None."""
+        return self._exemplar
 
     @property
     def count(self) -> int:
@@ -179,6 +203,8 @@ class MetricsRegistry:
             else:
                 out[name] = {"type": "histogram", "count": m.count,
                              "sum": m.sum, "buckets": m.cumulative()}
+                if m.exemplar is not None:
+                    out[name]["exemplar"] = dict(m.exemplar)
         return out
 
     def to_json(self) -> str:
@@ -200,7 +226,8 @@ class MetricsRegistry:
             else:
                 lines.append(f"# TYPE {pname} histogram")
                 for le, c in m.cumulative().items():
-                    lines.append(f'{pname}_bucket{{le="{le}"}} {c}')
+                    esc = escape_label_value(le)
+                    lines.append(f'{pname}_bucket{{le="{esc}"}} {c}')
                 lines.append(f"{pname}_sum {_fmt(m.sum)}")
                 lines.append(f"{pname}_count {m.count}")
         return "\n".join(lines) + ("\n" if lines else "")

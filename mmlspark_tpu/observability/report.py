@@ -2,7 +2,8 @@
 
 The offline half of the telemetry loop (``mmlspark-tpu report
 <events.jsonl>``): given the JSON-lines log a run produced under
-``observability.events_path``, print where the time went —
+``observability.events_path`` (or a flight-recorder dump — same schema),
+print where the time went —
 
 - per-stage wall-time breakdown: spans aggregated by name (count, total,
   mean, share of the root spans' wall time);
@@ -11,14 +12,23 @@ The offline half of the telemetry loop (``mmlspark-tpu report
   quarantines, by site;
 - liveness: watchdog stalls (per heartbeat, longest silence),
   circuit-breaker transitions, preemption signals/drains, quarantined
-  data-state sidecars;
+  data-state sidecars, flight-recorder dumps;
+- host syncs: ``sync.point`` events by site (the ROADMAP item-4
+  "zero host syncs per step" scoreboard — see observability/syncs.py);
 - throughput: the ``train.fit`` / ``train.step`` summaries the trainer and
   MetricLogger emit (steps, rows, examples/sec), plus any bench results;
 - serving: per-request SLO breakdown from the serve subsystem's
   ``serving.request`` events (p50/p99 total latency, mean queue/pad/compute
-  split, batch occupancy) plus shed/expired counts and the shed rate;
+  split, batch occupancy) plus shed/expired counts, the shed rate, and
+  tail-sampled slow-request trace ids;
 - input pipeline: per-epoch item counts and wall time from the streaming
   ``data.epoch`` events (data/pipeline.py's ``Repeat`` stage).
+
+:func:`build_report` produces all of the above as ONE structured dict
+(``mmlspark-tpu report --json``; CI and the bench regression gate consume
+it without scraping text); :func:`render_report` formats that dict as the
+human text. Span aggregation keys on ``(pid, span_id)`` so merged
+multi-process logs never alias two processes' spans.
 
 Pure text in, text out — no jax, no framework state — so it runs anywhere
 the log file can be copied to.
@@ -27,7 +37,7 @@ from __future__ import annotations
 
 import json
 from collections import defaultdict
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from mmlspark_tpu.utils.logging import get_logger
 
@@ -75,73 +85,79 @@ def _table(rows: List[List[str]], header: List[str]) -> List[str]:
     return lines
 
 
-def render_report(path: str, top: int = 10) -> str:
-    """The full text report for one event log."""
-    events = load_events(path)
+def build_report(path: str, top: int = 10,
+                 events: Optional[List[Dict[str, Any]]] = None
+                 ) -> Dict[str, Any]:
+    """One structured dict with every section of the run report (the
+    ``--json`` output). Sections with nothing to say are absent."""
+    if events is None:
+        events = load_events(path)
     spans = [e for e in events if e.get("type") == "span"]
     plain = [e for e in events if e.get("type") == "event"]
     metrics = [e for e in events if e.get("type") == "metric"]
 
-    out: List[str] = [f"run report: {path}",
-                      f"{len(events)} events "
-                      f"({len(spans)} spans, {len(metrics)} metrics)", ""]
+    report: Dict[str, Any] = {
+        "path": path,
+        "events": len(events),
+        "spans": len(spans),
+        "metrics": len(metrics),
+    }
 
-    # -- per-stage wall time -------------------------------------------------
+    # -- per-stage wall time (spans keyed per (pid, span_id)) --------------
     if spans:
         agg: Dict[str, List[float]] = defaultdict(list)
+        seen = set()
         for s in spans:
+            key = (s.get("pid") or 0, s.get("span_id"))
+            if key[1] is not None and key in seen:
+                continue               # merged-log duplicate
+            seen.add(key)
             agg[s.get("name", "?")].append(float(s.get("dur_s", 0.0)))
         # run wall = sum of root spans; fall back to the span total when the
         # log has no roots (e.g. a filtered or partial capture)
         root_total = sum(float(s.get("dur_s", 0.0)) for s in spans
                          if not s.get("parent_id"))
         denom = root_total or sum(sum(v) for v in agg.values()) or 1.0
-        rows = []
-        for name, durs in sorted(agg.items(),
-                                 key=lambda kv: -sum(kv[1]))[:top]:
-            total = sum(durs)
-            rows.append([name, len(durs), f"{total:.4f}",
-                         f"{total / len(durs) * 1e3:.2f}",
-                         f"{100.0 * total / denom:.1f}%"])
-        out.append("per-stage wall time:")
-        out.extend(_table(rows, ["span", "count", "total_s", "mean_ms",
-                                 "share"]))
-        out.append("")
+        report["stages"] = [
+            {"span": name, "count": len(durs), "total_s": round(sum(durs), 6),
+             "mean_ms": round(sum(durs) / len(durs) * 1e3, 4),
+             "share": round(100.0 * sum(durs) / denom, 2)}
+            for name, durs in sorted(agg.items(),
+                                     key=lambda kv: -sum(kv[1]))[:top]]
+        report["slowest"] = [
+            {"span": s.get("name", "?"),
+             "dur_s": round(float(s.get("dur_s", 0.0)), 6),
+             "depth": s.get("depth", 0), "pid": s.get("pid") or 0,
+             "parent": s.get("parent", "") or None}
+            for s in sorted(spans,
+                            key=lambda s: -float(s.get("dur_s", 0.0)))[:top]]
 
-        slow = sorted(spans, key=lambda s: -float(s.get("dur_s", 0.0)))[:top]
-        rows = [[s.get("name", "?"), f"{float(s.get('dur_s', 0.0)):.4f}",
-                 s.get("depth", 0), s.get("parent", "") or "-"]
-                for s in slow]
-        out.append("slowest spans:")
-        out.extend(_table(rows, ["span", "dur_s", "depth", "parent"]))
-        out.append("")
-
-    # -- reliability ---------------------------------------------------------
+    # -- reliability -------------------------------------------------------
     retries = [e for e in plain if e.get("name") == "retry.attempt"]
     faults = [e for e in plain if e.get("name") == "fault.hit"]
     quarantines = [e for e in plain
                    if e.get("name") == "checkpoint.quarantine"]
     if retries or faults or quarantines:
-        out.append("reliability:")
+        rel: Dict[str, Any] = {}
         if retries:
             by_site: Dict[str, int] = defaultdict(int)
             for e in retries:
                 by_site[e.get("policy", "?")] += 1
-            detail = ", ".join(f"{k}={v}" for k, v in sorted(by_site.items()))
-            out.append(f"  retry attempts: {len(retries)} ({detail})")
+            rel["retries"] = {"total": len(retries), "by_policy": dict(
+                sorted(by_site.items()))}
         if faults:
             by_site = defaultdict(int)
             for e in faults:
                 by_site[e.get("site", "?")] += 1
-            detail = ", ".join(f"{k}={v}" for k, v in sorted(by_site.items()))
-            out.append(f"  fault hits: {len(faults)} ({detail})")
+            rel["faults"] = {"total": len(faults),
+                             "by_site": dict(sorted(by_site.items()))}
         if quarantines:
-            steps = [e.get("step") for e in quarantines]
-            out.append(f"  checkpoint quarantines: {len(quarantines)} "
-                       f"(steps {steps})")
-        out.append("")
+            rel["quarantines"] = {"total": len(quarantines),
+                                  "steps": [e.get("step")
+                                            for e in quarantines]}
+        report["reliability"] = rel
 
-    # -- liveness ------------------------------------------------------------
+    # -- liveness ----------------------------------------------------------
     stalls = [e for e in plain if e.get("name") == "watchdog.stall"]
     trips = [e for e in plain
              if str(e.get("name", "")).startswith("breaker.")]
@@ -149,111 +165,284 @@ def render_report(path: str, top: int = 10) -> str:
     drains = [e for e in plain if e.get("name") == "preemption.drain"]
     ds_quar = [e for e in plain
                if e.get("name") == "checkpoint.data_state_quarantine"]
-    if stalls or trips or preempts or drains or ds_quar:
-        out.append("liveness:")
+    fdumps = [e for e in plain if e.get("name") == "flightrec.dump"]
+    if stalls or trips or preempts or drains or ds_quar or fdumps:
+        live: Dict[str, Any] = {}
         if stalls:
             by_hb: Dict[str, int] = defaultdict(int)
             for e in stalls:
                 by_hb[e.get("heartbeat", "?")] += 1
-            detail = ", ".join(f"{k}={v}" for k, v in sorted(by_hb.items()))
-            worst = max(float(e.get("stalled_s", 0.0)) for e in stalls)
-            out.append(f"  watchdog stalls: {len(stalls)} ({detail}); "
-                       f"longest {worst:.1f}s (stacks in the event log)")
+            live["stalls"] = {
+                "total": len(stalls),
+                "by_heartbeat": dict(sorted(by_hb.items())),
+                "longest_s": max(float(e.get("stalled_s", 0.0))
+                                 for e in stalls)}
         if trips:
             by_key: Dict[str, List[str]] = defaultdict(list)
             for e in trips:
                 by_key[e.get("key", "?")].append(
                     str(e.get("name", "")).split(".", 1)[-1])
-            detail = ", ".join(f"{k}: {'->'.join(v)}"
-                               for k, v in sorted(by_key.items()))
-            opened = sum(1 for e in trips if e.get("name") == "breaker.open")
-            out.append(f"  breaker transitions: {len(trips)} "
-                       f"({opened} trips to open) [{detail}]")
+            live["breakers"] = {
+                "transitions": len(trips),
+                "opened": sum(1 for e in trips
+                              if e.get("name") == "breaker.open"),
+                "by_key": dict(sorted(by_key.items()))}
         if preempts or drains:
-            reasons = sorted({str(e.get("reason", "?"))
-                              for e in preempts + drains})
-            kinds = ", ".join(
-                f"{e.get('kind', '?')}@step {e.get('step')}"
-                if "step" in e else str(e.get("kind", "?"))
-                for e in drains)
-            out.append(f"  preemptions: {len(preempts)} signalled, "
-                       f"{len(drains)} clean drains"
-                       + (f" ({kinds})" if kinds else "")
-                       + (f"; reasons: {', '.join(reasons)}"
-                          if reasons else ""))
+            live["preemptions"] = {
+                "signalled": len(preempts),
+                "drains": len(drains),
+                "drain_kinds": [
+                    {"kind": e.get("kind", "?"), "step": e.get("step")}
+                    for e in drains],
+                "reasons": sorted({str(e.get("reason", "?"))
+                                   for e in preempts + drains})}
         if ds_quar:
-            out.append(f"  data-state sidecars quarantined: {len(ds_quar)}")
-        out.append("")
+            live["data_state_quarantines"] = len(ds_quar)
+        if fdumps:
+            live["flight_dumps"] = [
+                {"reason": e.get("reason", "?"),
+                 "events": e.get("events"), "dropped": e.get("dropped")}
+                for e in fdumps]
+        report["liveness"] = live
 
-    # -- serving -------------------------------------------------------------
+    # -- host syncs (observability/syncs.py sync_point events) -------------
+    syncs = [e for e in plain if e.get("name") == "sync.point"]
+    if syncs:
+        by_site: Dict[str, int] = defaultdict(int)
+        by_span: Dict[str, int] = defaultdict(int)
+        for e in syncs:
+            by_site[e.get("site", "?")] += 1
+            if e.get("span"):
+                by_span[str(e["span"])] += 1
+        step_metrics = [m for m in metrics if m.get("name") == "train.step"]
+        sec: Dict[str, Any] = {"total": len(syncs),
+                               "by_site": dict(sorted(by_site.items()))}
+        if by_span:
+            sec["by_span"] = dict(sorted(by_span.items()))
+        if step_metrics:
+            steps = max(int(m.get("step", 0)) for m in step_metrics) or 1
+            sec["per_step"] = round(len(syncs) / steps, 4)
+        report["syncs"] = sec
+
+    # -- serving -----------------------------------------------------------
     serving = [e for e in events if e.get("type") == "serving"]
     reqs = [e for e in serving if e.get("name") == "request"]
     shed = [e for e in serving if e.get("name") == "shed"]
     expired = [e for e in serving if e.get("name") == "expired"]
     if serving:
-        out.append("serving:")
+        sv: Dict[str, Any] = {}
         if reqs:
             totals = sorted(float(e.get("total_ms", 0.0)) for e in reqs)
             by_model: Dict[str, int] = defaultdict(int)
             for e in reqs:
                 by_model[e.get("model", "?")] += 1
-            detail = ", ".join(f"{k}={v}" for k, v in sorted(by_model.items()))
-            out.append(
-                f"  requests: {len(reqs)} completed ({detail}); "
-                f"latency p50={_pct(totals, 50):.3f}ms "
-                f"p99={_pct(totals, 99):.3f}ms")
-            out.append(
-                f"  mean split: queue={_mean(reqs, 'queue_ms'):.3f}ms "
-                f"pad={_mean(reqs, 'pad_ms'):.3f}ms "
-                f"compute={_mean(reqs, 'compute_ms'):.3f}ms; "
-                f"batch occupancy mean="
-                f"{_mean(reqs, 'occupancy'):.2f}")
+            sv["requests"] = {
+                "completed": len(reqs),
+                "by_model": dict(sorted(by_model.items())),
+                "p50_ms": round(_pct(totals, 50), 3),
+                "p99_ms": round(_pct(totals, 99), 3),
+                "mean_queue_ms": round(_mean(reqs, "queue_ms"), 3),
+                "mean_pad_ms": round(_mean(reqs, "pad_ms"), 3),
+                "mean_compute_ms": round(_mean(reqs, "compute_ms"), 3),
+                "mean_occupancy": round(_mean(reqs, "occupancy"), 4)}
+            slow = [e for e in reqs if e.get("slow")]
+            if slow:
+                sv["slow_traces"] = [
+                    {"trace_id": e.get("trace_id"),
+                     "total_ms": e.get("total_ms")}
+                    for e in sorted(
+                        slow, key=lambda e: -float(e.get("total_ms", 0.0))
+                    )[:top]]
         offered = len(reqs) + len(shed)
-        rate = (100.0 * len(shed) / offered) if offered else 0.0
-        out.append(f"  shed: {len(shed)} ({rate:.1f}% of offered), "
-                   f"expired: {len(expired)}")
-        out.append("")
+        sv["shed"] = len(shed)
+        sv["shed_rate"] = round(
+            (100.0 * len(shed) / offered) if offered else 0.0, 2)
+        sv["expired"] = len(expired)
+        report["serving"] = sv
 
-    # -- throughput ----------------------------------------------------------
+    # -- throughput --------------------------------------------------------
     fits = [e for e in plain if e.get("name") == "train.fit"]
     step_metrics = [e for e in metrics if e.get("name") == "train.step"]
     if fits or step_metrics:
-        out.append("throughput:")
-        for e in fits:
-            out.append(
-                f"  train.fit: {e.get('steps', '?')} steps, "
-                f"{e.get('rows', '?')} rows in {e.get('wall_s', 0):.3f}s "
-                f"({e.get('examples_per_sec', 0):.1f} examples/sec)")
+        th: Dict[str, Any] = {}
+        if fits:
+            th["fits"] = [
+                {"steps": e.get("steps"), "rows": e.get("rows"),
+                 "wall_s": e.get("wall_s", 0),
+                 "examples_per_sec": e.get("examples_per_sec", 0)}
+                for e in fits]
         if step_metrics:
             last = step_metrics[-1]
             rates = [m.get("examples_per_sec", 0.0) for m in step_metrics]
-            out.append(
-                f"  train.step: {len(step_metrics)} logged steps, last "
-                f"step {last.get('step', '?')}, examples/sec last="
-                f"{rates[-1]:.1f} max={max(rates):.1f}")
-        out.append("")
+            th["steps"] = {"logged": len(step_metrics),
+                           "last_step": last.get("step"),
+                           "examples_per_sec_last": rates[-1],
+                           "examples_per_sec_max": max(rates)}
+        report["throughput"] = th
 
-    # -- input pipeline ------------------------------------------------------
+    # -- input pipeline ----------------------------------------------------
     epochs = [e for e in plain if e.get("name") == "data.epoch"]
     if epochs:
-        out.append("input pipeline:")
-        for e in epochs:
-            wall = float(e.get("wall_s", 0.0))
-            items = int(e.get("items", 0))
-            rate = items / wall if wall > 0 else 0.0
-            out.append(f"  epoch {e.get('epoch', '?')}: {items} items in "
-                       f"{wall:.3f}s ({rate:.1f} items/sec)")
-        out.append("")
+        report["input_pipeline"] = [
+            {"epoch": e.get("epoch"), "items": int(e.get("items", 0)),
+             "wall_s": float(e.get("wall_s", 0.0))}
+            for e in epochs]
 
-    # -- bench results -------------------------------------------------------
+    # -- bench results -----------------------------------------------------
     bench = [e for e in plain if e.get("name") == "bench.config"]
     if bench:
-        rows = []
-        for e in bench:
-            r = e.get("result") or {}
-            rows.append([e.get("config", "?"),
-                         r.get("value", "-"), r.get("unit", "-"),
-                         r.get("vs_baseline", "-")])
+        report["bench"] = [
+            {"config": e.get("config", "?"), **(e.get("result") or {})}
+            for e in bench]
+
+    return report
+
+
+def render_report(path: str, top: int = 10) -> str:
+    """The full text report for one event log."""
+    r = build_report(path, top=top)
+    out: List[str] = [f"run report: {path}",
+                      f"{r['events']} events "
+                      f"({r['spans']} spans, {r['metrics']} metrics)", ""]
+
+    if "stages" in r:
+        rows = [[s["span"], s["count"], f"{s['total_s']:.4f}",
+                 f"{s['mean_ms']:.2f}", f"{s['share']:.1f}%"]
+                for s in r["stages"]]
+        out.append("per-stage wall time:")
+        out.extend(_table(rows, ["span", "count", "total_s", "mean_ms",
+                                 "share"]))
+        out.append("")
+        rows = [[s["span"], f"{s['dur_s']:.4f}", s["depth"],
+                 s["parent"] or "-"] for s in r["slowest"]]
+        out.append("slowest spans:")
+        out.extend(_table(rows, ["span", "dur_s", "depth", "parent"]))
+        out.append("")
+
+    if "reliability" in r:
+        rel = r["reliability"]
+        out.append("reliability:")
+        if "retries" in rel:
+            detail = ", ".join(f"{k}={v}" for k, v in
+                               rel["retries"]["by_policy"].items())
+            out.append(f"  retry attempts: {rel['retries']['total']} "
+                       f"({detail})")
+        if "faults" in rel:
+            detail = ", ".join(f"{k}={v}" for k, v in
+                               rel["faults"]["by_site"].items())
+            out.append(f"  fault hits: {rel['faults']['total']} ({detail})")
+        if "quarantines" in rel:
+            out.append(f"  checkpoint quarantines: "
+                       f"{rel['quarantines']['total']} "
+                       f"(steps {rel['quarantines']['steps']})")
+        out.append("")
+
+    if "liveness" in r:
+        live = r["liveness"]
+        out.append("liveness:")
+        if "stalls" in live:
+            detail = ", ".join(f"{k}={v}" for k, v in
+                               live["stalls"]["by_heartbeat"].items())
+            out.append(f"  watchdog stalls: {live['stalls']['total']} "
+                       f"({detail}); longest "
+                       f"{live['stalls']['longest_s']:.1f}s "
+                       "(stacks in the event log)")
+        if "breakers" in live:
+            detail = ", ".join(f"{k}: {'->'.join(v)}" for k, v in
+                               live["breakers"]["by_key"].items())
+            out.append(f"  breaker transitions: "
+                       f"{live['breakers']['transitions']} "
+                       f"({live['breakers']['opened']} trips to open) "
+                       f"[{detail}]")
+        if "preemptions" in live:
+            pre = live["preemptions"]
+            kinds = ", ".join(
+                f"{d['kind']}@step {d['step']}" if d["step"] is not None
+                else str(d["kind"]) for d in pre["drain_kinds"])
+            out.append(f"  preemptions: {pre['signalled']} signalled, "
+                       f"{pre['drains']} clean drains"
+                       + (f" ({kinds})" if kinds else "")
+                       + (f"; reasons: {', '.join(pre['reasons'])}"
+                          if pre["reasons"] else ""))
+        if "data_state_quarantines" in live:
+            out.append(f"  data-state sidecars quarantined: "
+                       f"{live['data_state_quarantines']}")
+        if "flight_dumps" in live:
+            detail = ", ".join(f"{d['reason']} ({d['events']} events)"
+                               for d in live["flight_dumps"])
+            out.append(f"  flight-recorder dumps: "
+                       f"{len(live['flight_dumps'])} [{detail}]")
+        out.append("")
+
+    if "syncs" in r:
+        sy = r["syncs"]
+        out.append("host syncs:")
+        detail = ", ".join(f"{k}={v}" for k, v in sy["by_site"].items())
+        line = f"  sync points: {sy['total']} ({detail})"
+        if "per_step" in sy:
+            line += f"; per train step: {sy['per_step']:.2f}"
+        out.append(line)
+        if "by_span" in sy:
+            detail = ", ".join(f"{k}={v}" for k, v in sy["by_span"].items())
+            out.append(f"  by span: {detail}")
+        out.append("")
+
+    if "serving" in r:
+        sv = r["serving"]
+        out.append("serving:")
+        if "requests" in sv:
+            rq = sv["requests"]
+            detail = ", ".join(f"{k}={v}"
+                               for k, v in rq["by_model"].items())
+            out.append(
+                f"  requests: {rq['completed']} completed ({detail}); "
+                f"latency p50={rq['p50_ms']:.3f}ms "
+                f"p99={rq['p99_ms']:.3f}ms")
+            out.append(
+                f"  mean split: queue={rq['mean_queue_ms']:.3f}ms "
+                f"pad={rq['mean_pad_ms']:.3f}ms "
+                f"compute={rq['mean_compute_ms']:.3f}ms; "
+                f"batch occupancy mean={rq['mean_occupancy']:.2f}")
+        if sv.get("slow_traces"):
+            detail = ", ".join(f"{t['trace_id']} ({t['total_ms']}ms)"
+                               for t in sv["slow_traces"][:3])
+            out.append(f"  slow traces (tail-sampled): "
+                       f"{len(sv['slow_traces'])} [{detail}]")
+        out.append(f"  shed: {sv['shed']} ({sv['shed_rate']:.1f}% of "
+                   f"offered), expired: {sv['expired']}")
+        out.append("")
+
+    if "throughput" in r:
+        th = r["throughput"]
+        out.append("throughput:")
+        for e in th.get("fits", ()):
+            out.append(
+                f"  train.fit: {e['steps'] if e['steps'] is not None else '?'}"
+                f" steps, {e['rows'] if e['rows'] is not None else '?'} rows"
+                f" in {e['wall_s']:.3f}s "
+                f"({e['examples_per_sec']:.1f} examples/sec)")
+        if "steps" in th:
+            st = th["steps"]
+            out.append(
+                f"  train.step: {st['logged']} logged steps, last "
+                f"step {st['last_step'] if st['last_step'] is not None else '?'}, "
+                f"examples/sec last={st['examples_per_sec_last']:.1f} "
+                f"max={st['examples_per_sec_max']:.1f}")
+        out.append("")
+
+    if "input_pipeline" in r:
+        out.append("input pipeline:")
+        for e in r["input_pipeline"]:
+            rate = e["items"] / e["wall_s"] if e["wall_s"] > 0 else 0.0
+            out.append(f"  epoch {e['epoch'] if e['epoch'] is not None else '?'}: "
+                       f"{e['items']} items in "
+                       f"{e['wall_s']:.3f}s ({rate:.1f} items/sec)")
+        out.append("")
+
+    if "bench" in r:
+        rows = [[b.get("config", "?"), b.get("value", "-"),
+                 b.get("unit", "-"), b.get("vs_baseline", "-")]
+                for b in r["bench"]]
         out.append("bench configs:")
         out.extend(_table(rows, ["config", "value", "unit", "vs_baseline"]))
         out.append("")

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import contextvars
 import itertools
+import os
 import threading
 from typing import Any, Optional, Tuple
 
@@ -32,6 +33,17 @@ _STACK: contextvars.ContextVar[Tuple[Tuple[str, int], ...]] = \
     contextvars.ContextVar("mmlspark_tpu_span_stack", default=())
 _ids = itertools.count(1)
 _ids_lock = threading.Lock()
+
+
+def next_span_id() -> int:
+    """Allocate a span id from the process counter. Span ids are unique
+    only WITHIN a process — every span event therefore carries ``pid``,
+    and consumers (report, trace export) key on ``(pid, span_id)`` so
+    multi-host/merged logs never collide. Used by the tail-sampling path
+    in ``serve/`` to mint ids for retroactively-emitted spans without
+    colliding with live ones."""
+    with _ids_lock:
+        return next(_ids)
 
 
 class _NoopSpan:
@@ -56,8 +68,7 @@ class _Span:
     def __init__(self, name: str, attrs: dict, annotate: bool):
         self.name = name
         self.attrs = attrs
-        with _ids_lock:
-            self.span_id = next(_ids)
+        self.span_id = next_span_id()
         self._annotation = None
         if annotate:
             from mmlspark_tpu.utils.profiling import annotate as _annotate
@@ -81,6 +92,7 @@ class _Span:
         _STACK.reset(self._token)
         fields = {
             "span_id": self.span_id,
+            "pid": os.getpid(),
             "parent_id": self._parent[1] if self._parent else None,
             "parent": self._parent[0] if self._parent else "",
             "depth": self._depth,
@@ -104,7 +116,10 @@ def span(kind: str, detail: str = "", **attrs: Any):
     emitted event (keep them small and JSON-friendly).
     """
     annotate = bool(config.get("observability.annotate"))
-    if not (annotate or events.events_enabled()):
+    # recording_enabled, not events_enabled: the flight recorder (on by
+    # default) captures spans too, so an incident dump has the timeline —
+    # the true-noop fast path needs ALL three sinks off
+    if not (annotate or events.recording_enabled()):
         return _NOOP
     return _Span(f"{kind}:{detail}" if detail else kind, attrs, annotate)
 

@@ -1,0 +1,84 @@
+"""Host-sync accounting: make every device round trip a counted event.
+
+ROADMAP item 4's target is "zero host syncs per step" in the low-MFU
+lanes — but a target you cannot measure is a slogan. Every
+``jax.device_get``/``block_until_ready`` is a host<->device round trip
+(the dispatch pipeline drains, the host blocks); this module is the ONE
+place they are allowed to happen (lint Rule 7 flags the raw calls
+anywhere else without a ``# lint: allow-sync`` marker), and each one is
+accounted:
+
+- ``observability.sync_points`` counter (total) plus a per-site counter
+  ``observability.sync_points.<site>`` — the scoreboard;
+- a ``sync.point`` event carrying the site and the innermost open span's
+  ``(name, span_id, pid)``, so a report/trace can attribute the sync to
+  the phase that paid for it (gated on :func:`events.recording_enabled`,
+  so syncs land in the flight recorder too);
+- the trainer samples :func:`total` around its fit loop and publishes the
+  per-step delta as the ``train.sync_points_per_step`` gauge — the number
+  item 4 drives to zero.
+
+``sync_point(site)`` is the primitive; :func:`device_get` and
+:func:`block_until_ready` wrap the jax calls for drop-in replacement at
+call sites. Counting is a plain int add under a lock — cheap enough that
+it is unconditional, like the cold-path counters in :mod:`metrics`.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from mmlspark_tpu.observability import events, metrics, spans
+
+_lock = threading.Lock()
+_total = 0
+
+
+def total() -> int:
+    """Lifetime sync-point count for this process (the trainer diffs this
+    around a step to compute syncs-per-step)."""
+    return _total
+
+
+def sync_point(site: str, kind: str = "sync") -> None:
+    """Record one host sync at ``site`` (e.g. ``"trainer.collect_losses"``).
+
+    ``kind`` names the blocking primitive (``device_get`` /
+    ``block_until_ready``) for the event log. Counts unconditionally;
+    emits a ``sync.point`` event (with current-span attribution) when any
+    event sink is live.
+    """
+    global _total
+    with _lock:
+        _total += 1
+    metrics.counter("observability.sync_points").inc()
+    metrics.counter(f"observability.sync_points.{site}").inc()
+    if events.recording_enabled():
+        cur = spans.current_span()
+        events.emit("event", "sync.point", site=site, kind=kind,
+                    span=cur[0] if cur else None,
+                    span_id=cur[1] if cur else None)
+
+
+def device_get(x: Any, site: str) -> Any:
+    """Counted ``jax.device_get`` — the sanctioned spelling of a
+    device->host transfer outside this module."""
+    sync_point(site, "device_get")
+    import jax
+    return jax.device_get(x)  # lint: allow-sync (the accounting home)
+
+
+def block_until_ready(x: Any, site: str) -> Any:
+    """Counted ``jax.block_until_ready`` (works for arrays and pytrees;
+    also the spelling for ``arr.block_until_ready()`` method-call sites).
+    """
+    sync_point(site, "block_until_ready")
+    import jax
+    return jax.block_until_ready(x)  # lint: allow-sync (the accounting home)
+
+
+def reset(_only_for_tests: Optional[bool] = None) -> None:
+    """Zero the process total (tests measuring per-phase deltas)."""
+    global _total
+    with _lock:
+        _total = 0

@@ -33,6 +33,7 @@ import jax
 
 from mmlspark_tpu.observability import events as obsevents
 from mmlspark_tpu.observability import metrics as obsmetrics
+from mmlspark_tpu.observability import syncs
 from mmlspark_tpu.observability.spans import span
 from mmlspark_tpu.reliability.faults import fault_site
 from mmlspark_tpu.utils.logging import get_logger
@@ -62,7 +63,7 @@ class TrainCheckpointer:
              wait: bool = False) -> int:
         """Save (async by default); step defaults to state['step']."""
         if step is None:
-            step = int(jax.device_get(state["step"]))
+            step = int(syncs.device_get(state["step"], "checkpoint.step"))
         stale = os.path.join(self.directory, str(step))
         if os.path.isdir(stale):
             # A dead run's in-flight save for this step landed after restore

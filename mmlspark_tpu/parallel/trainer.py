@@ -33,6 +33,7 @@ from mmlspark_tpu.data.prefetch import DevicePrefetcher  # noqa: F401
 from mmlspark_tpu.parallel.mesh import mesh_from_config
 from mmlspark_tpu.observability import events as obsevents
 from mmlspark_tpu.observability import metrics as obsmetrics
+from mmlspark_tpu.observability import syncs as obssyncs
 from mmlspark_tpu.reliability import watchdog as _watchdog
 from mmlspark_tpu.reliability.faults import fault_site
 from mmlspark_tpu.parallel.sharding import (
@@ -203,7 +204,7 @@ class DeviceEpochCache:
         with self.mesh:
             batches = self._split(tensor_dict, self.steps_per_epoch)
             if is_cpu_mesh(self.mesh):
-                jax.block_until_ready(batches)
+                obssyncs.block_until_ready(batches, "trainer.materialize")
         return batches
 
     def batches(self, epoch: int = 0):
@@ -356,7 +357,8 @@ class DistributedTrainer:
         if self._throttled:
             self._inflight.append(out[1]["loss"])
             if len(self._inflight) > self._THROTTLE:
-                jax.block_until_ready(self._inflight.pop(0))
+                obssyncs.block_until_ready(self._inflight.pop(0),
+                                           "trainer.throttle")
         return out
 
     def eval_step(self, state, batch, rng) -> jax.Array:
@@ -456,6 +458,7 @@ class DistributedTrainer:
         if telemetry:
             step_hist = obsmetrics.histogram("trainer.step_time_seconds")
             t_start = t_prev = obsevents.perf()
+            sync_t0 = obssyncs.total()
         prefetcher = DevicePrefetcher(batches, self.put_batch, depth=prefetch)
         # liveness: one beat per dispatched step — a wedged collective or
         # stuck input shows up as this heartbeat going silent, and the
@@ -496,16 +499,22 @@ class DistributedTrainer:
             # one sync per EPOCH (the exit paths below all wait on the last
             # loss anyway) so throughput covers completed device work, not
             # just async dispatch
-            jax.block_until_ready(losses[-1])
+            obssyncs.block_until_ready(losses[-1],
+                                       "trainer.epoch_telemetry")
+            # the ROADMAP item-4 scoreboard: host round trips amortized
+            # over the epoch's steps (0 is the target in cached lanes)
+            obsmetrics.gauge("train.sync_points_per_step").set(
+                (obssyncs.total() - sync_t0) / steps)
             self._finish_epoch_telemetry(steps, rows_total,
                                          obsevents.perf() - t_start)
         if not losses:
             return state, []
         if not collect_losses:
-            jax.block_until_ready(losses[-1])
+            obssyncs.block_until_ready(losses[-1], "trainer.fit_exit")
             return state, []
         # one stack + one transfer: device_get on a LIST of device scalars
         # fetches each individually — a round trip per step on remote chips
         with self.mesh:
             stacked = jnp.stack(losses)
-        return state, [float(l) for l in np.asarray(jax.device_get(stacked))]
+        return state, [float(l) for l in np.asarray(
+            obssyncs.device_get(stacked, "trainer.collect_losses"))]

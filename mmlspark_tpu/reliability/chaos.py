@@ -148,8 +148,8 @@ def _batch_fn(seed: int) -> Callable[[int], Dict[str, Any]]:
 def _bit_identical(a: Any, b: Any) -> bool:
     import jax
     import numpy as np
-    fa, ta = jax.tree_util.tree_flatten(jax.device_get(a))
-    fb, tb = jax.tree_util.tree_flatten(jax.device_get(b))
+    fa, ta = jax.tree_util.tree_flatten(jax.device_get(a))  # lint: allow-sync
+    fb, tb = jax.tree_util.tree_flatten(jax.device_get(b))  # lint: allow-sync
     if ta != tb:
         return False
     return all(np.array_equal(x, y) for x, y in zip(fa, fb))
@@ -347,4 +347,13 @@ def run_scenario(seed: int, outdir: str, total_steps: int = 8,
     os.replace(tmp, path)
     _LOG.info("chaos verdict (%s): %s", path,
               "PASS" if verdict["passed"] else "FAIL")
+    if not verdict["passed"]:
+        # a red verdict ships its own forensics: the last-N telemetry
+        # events land next to the verdict even with events_path unset
+        from mmlspark_tpu.observability import flightrec
+        dumped = flightrec.dump(
+            reason=f"chaos.red.seed{seed}",
+            path=os.path.join(outdir, "chaos_flightrec.jsonl"))
+        if dumped:
+            _LOG.error("chaos: flight recorder dumped to %s", dumped)
     return verdict

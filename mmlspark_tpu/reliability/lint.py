@@ -36,6 +36,14 @@ preemption layer's SIGTERM->clean-checkpoint path. All handler
 installation goes through ``reliability.preemption``; intentional
 exceptions mark the line ``# lint: allow-signal``.
 
+Rule 7 — raw ``jax.device_get(...)`` / ``block_until_ready(...)`` outside
+``observability/syncs.py``: every one is a host<->device round trip the
+sync accounter cannot see, which silently falsifies the ROADMAP item-4
+"syncs per step" scoreboard. Route through ``syncs.device_get`` /
+``syncs.block_until_ready`` (calls whose receiver mentions ``sync`` are
+recognized as the wrappers); deliberate raw syncs mark the line
+``# lint: allow-sync``.
+
 Shared core for ``tools/check_reliability.py`` (standalone CLI),
 ``mmlspark-tpu check`` (installed CLI), and the in-pytest gate
 (tests/test_reliability_lint.py) — same single source of truth pattern as
@@ -80,8 +88,29 @@ def _catches_everything(node: ast.expr) -> bool:
 
 _ALLOW_PRINT = "# lint: allow-print"
 _ALLOW_SIGNAL = "# lint: allow-signal"
+_ALLOW_SYNC = "# lint: allow-sync"
 # the ONE module allowed to install process-global signal handlers
 _SIGNAL_HOME = "reliability/preemption.py"
+# the ONE module allowed to call the raw blocking primitives
+_SYNC_HOME = "observability/syncs.py"
+_SYNC_CALLS = ("device_get", "block_until_ready")
+
+
+def _is_raw_sync(call: ast.Call) -> bool:
+    """``jax.device_get(...)``, ``arr.block_until_ready()``, or a bare
+    ``device_get(...)`` name call — any spelling of the raw blocking
+    primitives. Calls routed through the accounting wrappers are exempt:
+    an attribute call whose receiver NAME mentions ``sync``
+    (``syncs.device_get``, ``obssyncs.block_until_ready``) is the wrapper,
+    not the primitive."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id in _SYNC_CALLS
+    if isinstance(f, ast.Attribute) and f.attr in _SYNC_CALLS:
+        if isinstance(f.value, ast.Name) and "sync" in f.value.id:
+            return False
+        return True
+    return False
 
 
 def _is_signal_signal(call: ast.Call) -> bool:
@@ -99,7 +128,9 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     problems: List[str] = []
     tree = ast.parse(src, filename=filename)
     lines = src.splitlines()
-    signal_home = str(filename).replace("\\", "/").endswith(_SIGNAL_HOME)
+    norm = str(filename).replace("\\", "/")
+    signal_home = norm.endswith(_SIGNAL_HOME)
+    sync_home = norm.endswith(_SYNC_HOME)
 
     def _allowed(lineno: int) -> bool:
         # marker anywhere on the offending line opts that line out
@@ -109,6 +140,10 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     def _signal_allowed(lineno: int) -> bool:
         return (0 < lineno <= len(lines)
                 and _ALLOW_SIGNAL in lines[lineno - 1])
+
+    def _sync_allowed(lineno: int) -> bool:
+        return (0 < lineno <= len(lines)
+                and _ALLOW_SYNC in lines[lineno - 1])
 
     for node in ast.walk(tree):
         if (isinstance(node, ast.Call)
@@ -157,6 +192,15 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
                 "last-installer-wins; route through "
                 "reliability.preemption, or mark the line "
                 f"`{_ALLOW_SIGNAL}`)")
+        elif (isinstance(node, ast.Call) and _is_raw_sync(node)
+                and not sync_home
+                and not _sync_allowed(node.lineno)):
+            problems.append(
+                f"{filename}:{node.lineno}: raw device_get/"
+                "block_until_ready outside "
+                f"{_SYNC_HOME} (uncounted host sync; route through "
+                "syncs.device_get/syncs.block_until_ready, or mark the "
+                f"line `{_ALLOW_SYNC}`)")
         elif isinstance(node, ast.ExceptHandler):
             if node.type is None:
                 problems.append(

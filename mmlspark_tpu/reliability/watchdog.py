@@ -224,13 +224,19 @@ class Watchdog:
             stall.timeout_s, stall.beats, stall.stacks)
         # a stall is rare and already catastrophic-adjacent: count and
         # emit unconditionally-cheap telemetry, never swallow its cost
-        from mmlspark_tpu.observability import events, metrics
+        from mmlspark_tpu.observability import events, flightrec, metrics
         metrics.counter("reliability.watchdog_stalls").inc()
-        if events.events_enabled():
+        if events.recording_enabled():
             events.emit("event", "watchdog.stall", heartbeat=stall.name,
                         stalled_s=round(stall.stalled_s, 3),
                         timeout_s=stall.timeout_s, beats=stall.beats,
                         stacks=stall.stacks)
+        # persist the in-memory ring NOW: a stall often precedes a SIGKILL
+        # (driver timeout), after which there is nothing left to dump —
+        # this works with events_path unset, which is the whole point
+        dumped = flightrec.dump(reason=f"watchdog.stall.{stall.name}")
+        if dumped:
+            _LOG.error("watchdog: flight recorder dumped to %s", dumped)
         try:
             if callable(self.action):
                 self.action(stall)

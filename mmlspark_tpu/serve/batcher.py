@@ -30,18 +30,23 @@ class Ticket:
     """One admitted request: ``rows`` coerced examples bound for ``model``,
     plus the future its caller is blocked on. ``enqueued`` and ``deadline``
     are absolute times on the server's (injectable) clock; ``deadline``
-    None means the request never expires."""
+    None means the request never expires. ``trace_id`` is minted at
+    admission and rides through shed/expired/request events (and the
+    tail-sampled span timeline) so one request's records correlate."""
 
-    __slots__ = ("model", "x", "rows", "future", "enqueued", "deadline")
+    __slots__ = ("model", "x", "rows", "future", "enqueued", "deadline",
+                 "trace_id")
 
     def __init__(self, model: str, x, rows: int, future,
-                 enqueued: float, deadline: Optional[float] = None):
+                 enqueued: float, deadline: Optional[float] = None,
+                 trace_id: str = ""):
         self.model = model
         self.x = x
         self.rows = rows
         self.future = future
         self.enqueued = enqueued
         self.deadline = deadline
+        self.trace_id = trace_id
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline

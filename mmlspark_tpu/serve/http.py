@@ -5,7 +5,9 @@ JSON in, JSON out, zero new dependencies — the transport half of
 
 - ``POST /score`` — body ``{"model": "name", "x": [[...], ...],
   "deadline_ms": 50}`` (``x`` one row or a list of rows; ``deadline_ms``
-  optional). 200 -> ``{"y": [[...], ...]}``. Error mapping keeps the
+  optional). 200 -> ``{"y": [[...], ...]}`` (plus the request's
+  ``trace_id`` for single-row bodies — grep it in the event log /
+  exported trace). Error mapping keeps the
   server's admission semantics visible to HTTP clients:
   ``ServerOverloaded`` -> **503** (with ``Retry-After: 0``, the
   HTTP-native "retryable" signal — ``default_retryable`` already treats
@@ -99,10 +101,15 @@ def make_handler(server: Server):
             except (KeyError, ValueError, TypeError) as e:
                 self._reply(400, {"error": f"bad request: {e}"})
                 return
+            trace_id = ""
             try:
                 if x.ndim <= 1:
-                    y = server.submit(model, x, deadline_ms)
+                    fut = server.submit_async(model, x, deadline_ms)
+                    trace_id = getattr(fut, "trace_id", "")
+                    y = fut.result()
                 else:
+                    # multi-row bodies fan out into several tickets; no
+                    # single id to return
                     y = server.submit_many(model, x, deadline_ms)
             except ServerOverloaded as e:
                 # Retry-After: 1 while draining (this replica is going
@@ -120,7 +127,10 @@ def make_handler(server: Server):
             except ServeError as e:
                 self._reply(500, {"error": str(e)})
             else:
-                self._reply(200, {"y": np.asarray(y).tolist()})
+                payload = {"y": np.asarray(y).tolist()}
+                if trace_id:
+                    payload["trace_id"] = trace_id
+                self._reply(200, payload)
 
     return Handler
 

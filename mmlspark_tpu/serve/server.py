@@ -32,6 +32,8 @@ DataFrames; a request had to wait for a batch job). The shape:
 """
 from __future__ import annotations
 
+import itertools
+import os
 import queue
 import threading
 from concurrent.futures import Future
@@ -39,7 +41,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from mmlspark_tpu.observability import events, metrics
+from mmlspark_tpu.observability import events, metrics, spans
 from mmlspark_tpu.reliability import watchdog as _watchdog
 from mmlspark_tpu.reliability.faults import fault_site
 from mmlspark_tpu.serve.batcher import (
@@ -52,6 +54,18 @@ from mmlspark_tpu.utils.logging import get_logger
 logger = get_logger("serve")
 
 _STOP = object()
+
+# request trace ids: per-process counter + pid, so merged multi-replica
+# logs never collide and an id is greppable end to end (shed/expired/
+# request events, tail-sampled spans, histogram exemplars, HTTP response)
+_trace_ids = itertools.count(1)
+_trace_lock = threading.Lock()
+
+
+def _mint_trace_id() -> str:
+    with _trace_lock:
+        n = next(_trace_ids)
+    return f"t-{os.getpid():x}-{n:x}"
 
 
 class ServeError(RuntimeError):
@@ -241,7 +255,11 @@ class Server:
             deadline_ms = dms if dms > 0 else None
         deadline = now + deadline_ms / 1e3 if deadline_ms else None
         ticket = Ticket(model, coerced, coerced.shape[0], Future(),
-                        enqueued=now, deadline=deadline)
+                        enqueued=now, deadline=deadline,
+                        trace_id=_mint_trace_id())
+        # callers (the HTTP front-end) read the id off the future they
+        # already hold — no parallel return channel needed
+        ticket.future.trace_id = ticket.trace_id
         fault_site("serve.enqueue", {"model": model,
                                      "rows": ticket.rows})
         try:
@@ -258,9 +276,9 @@ class Server:
                 self._queue.put_nowait(ticket)
         except queue.Full:
             self._shed.inc()
-            if events.events_enabled():
+            if events.recording_enabled():
                 events.emit("serving", "shed", model=model,
-                            rows=ticket.rows)
+                            rows=ticket.rows, trace_id=ticket.trace_id)
             raise ServerOverloaded(
                 f"queue full ({self._queue.maxsize} pending); retry with "
                 "backoff") from None
@@ -347,9 +365,9 @@ class Server:
         for t in group:
             if t.expired(t_dequeue):
                 self._expired.inc()
-                if events.events_enabled():
+                if events.recording_enabled():
                     events.emit("serving", "expired", model=t.model,
-                                rows=t.rows,
+                                rows=t.rows, trace_id=t.trace_id,
                                 waited_ms=round(
                                     (t_dequeue - t.enqueued) * 1e3, 3))
                 t.future.set_exception(RequestExpired(
@@ -389,7 +407,8 @@ class Server:
                  rows: int, t_dequeue: float, t_padded: float,
                  t_scored: float) -> None:
         hot = metrics.metrics_enabled()
-        log = events.events_enabled()
+        log = events.recording_enabled()
+        slow_ms = float(mmlconfig.get("observability.trace_slow_ms") or 0.0)
         pad_s = t_padded - t_dequeue
         compute_s = t_scored - t_padded
         if hot:
@@ -402,19 +421,65 @@ class Server:
             offset += t.rows
             queue_s = t_dequeue - t.enqueued
             total_s = t_scored - t.enqueued
+            # tail sampling: only requests over the threshold pay for full
+            # span detail; everyone else keeps the one cheap request event
+            slow = slow_ms > 0 and total_s * 1e3 >= slow_ms
             self._completed.inc()
             if hot:
-                metrics.histogram("serving.queue_ms").observe(queue_s * 1e3)
-                metrics.histogram("serving.total_ms").observe(total_s * 1e3)
+                ex = t.trace_id if slow else None
+                metrics.histogram("serving.queue_ms").observe(
+                    queue_s * 1e3, exemplar=ex)
+                metrics.histogram("serving.total_ms").observe(
+                    total_s * 1e3, exemplar=ex)
             if log:
                 events.emit("serving", "request", model=t.model,
                             rows=t.rows, bucket=bucket,
+                            trace_id=t.trace_id, slow=slow,
                             occupancy=round(rows / bucket, 4),
                             queue_ms=round(queue_s * 1e3, 3),
                             pad_ms=round(pad_s * 1e3, 3),
                             compute_ms=round(compute_s * 1e3, 3),
                             total_ms=round(total_s * 1e3, 3))
+                if slow:
+                    self._emit_slow_trace(t, queue_s, pad_s, compute_s,
+                                          total_s, bucket)
             t.future.set_result(res)
+
+    def _emit_slow_trace(self, t: Ticket, queue_s: float, pad_s: float,
+                         compute_s: float, total_s: float,
+                         bucket: int) -> None:
+        """Retroactively emit the span timeline of one slow request:
+        a ``serve:request`` root with ``queue``/``pad``/``compute``
+        children, every span carrying the ticket's ``trace_id``.
+
+        Spans can only be emitted retroactively here — at enqueue time
+        nobody knows the request will be slow; that is the point of tail
+        sampling. Wall-clock starts are back-dated from ``events.wall()``
+        by the executor-clock durations, so the exported trace nests these
+        under the same timeline as live spans (and the back-dating works
+        under the tests' injected clocks too)."""
+        wall_end = events.wall()
+        pid = os.getpid()
+        root_id = spans.next_span_id()
+        root_start = wall_end - total_s
+
+        def emit(name: str, span_id: int, parent_id: Optional[int],
+                 depth: int, start: float, dur: float, **attrs) -> None:
+            events.emit(
+                "span", name, span_id=span_id, pid=pid,
+                parent_id=parent_id,
+                parent="serve:request" if parent_id else "",
+                depth=depth, start=round(start, 6), dur_s=round(dur, 9),
+                attrs={"trace_id": t.trace_id, **attrs})
+
+        emit("serve:request", root_id, None, 0, root_start, total_s,
+             model=t.model, rows=t.rows, bucket=bucket)
+        emit("serve:queue", spans.next_span_id(), root_id, 1,
+             root_start, queue_s)
+        emit("serve:pad", spans.next_span_id(), root_id, 1,
+             root_start + queue_s, pad_s)
+        emit("serve:compute", spans.next_span_id(), root_id, 1,
+             root_start + queue_s + pad_s, compute_s)
 
     # -- introspection -----------------------------------------------------
     def stats(self) -> Dict[str, float]:

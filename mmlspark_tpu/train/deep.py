@@ -49,6 +49,7 @@ from mmlspark_tpu.core.params import (
 )
 from mmlspark_tpu.core.pipeline import Model
 from mmlspark_tpu.core.serialization import register_stage
+from mmlspark_tpu.observability import syncs as obssyncs
 from mmlspark_tpu.train.learners import (
     FeaturizeHints, JaxEstimator, _score_classifier,
 )
@@ -387,7 +388,8 @@ class _DeepEstimatorBase(JaxEstimator):
             state, resumed = trainer.init(init_params_fn), False
         # Elastic resume: whole epochs already trained are skipped
         # arithmetically; only the partial epoch streams batches past.
-        done = min(int(jax.device_get(state["step"])), total_steps)
+        done = min(int(obssyncs.device_get(state["step"], "deep.resume_step")),
+                   total_steps)
         start_epoch = done // steps_per_epoch
         skip_in_epoch = done - start_epoch * steps_per_epoch
         rng = jax.random.PRNGKey(seed)
@@ -522,7 +524,8 @@ class _DeepEstimatorBase(JaxEstimator):
                             out = val_fn(state["params"], b)
                             sums_dev = out if sums_dev is None \
                                 else sums_dev + out
-                    vm = finalize(np.asarray(jax.device_get(sums_dev)))
+                    vm = finalize(np.asarray(
+                        obssyncs.device_get(sums_dev, "deep.validation")))
                     val_log(epoch + 1, vm)
                     self.validation_history.append(
                         {"epoch": epoch + 1, **vm})
@@ -558,13 +561,14 @@ class _DeepEstimatorBase(JaxEstimator):
                 params = jax.jit(
                     lambda p: p,
                     out_shardings=NamedSharding(mesh, PartitionSpec()))(params)
-        params_host = jax.device_get(params)
+        params_host = obssyncs.device_get(params, "deep.fetch_params")
         from mmlspark_tpu.models.jax_model import _to_plain
         state_arrays = {
             "params": _to_plain(params_host),
             "mu": mu, "sigma": sigma,
             "standardize": np.asarray(standardize),
-            "final_loss": np.asarray(float(jax.device_get(last_loss))),
+            "final_loss": np.asarray(float(
+                obssyncs.device_get(last_loss, "deep.final_loss"))),
             # plain list-of-dicts: JSON side of the state, survives
             # save_stage/load_stage (models expose it as a property)
             "validation_history": list(self.validation_history),

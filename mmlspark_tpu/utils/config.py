@@ -65,6 +65,13 @@ _DEFAULTS: Dict[str, Any] = {
     "observability.metrics": False,   # hot-path (per-step) metric collection
     "observability.annotate": False,  # span() also opens a TraceAnnotation
     "observability.peak_tflops": 197.0,  # MFU denominator (v5e bf16 peak)
+    "observability.trace_slow_ms": 0.0,  # >0 = serve requests slower than
+                                         # this emit full span detail +
+                                         # histogram exemplars (tail
+                                         # sampling; docs/OBSERVABILITY.md)
+    "observability.flight_recorder_size": 256,  # last-N in-memory event
+                                                # ring, dumped on stall/
+                                                # chaos-red/crash (0 = off)
 }
 
 _lock = threading.Lock()

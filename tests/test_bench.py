@@ -98,7 +98,7 @@ def _fake_config(value=123.0):
 def test_main_prints_exactly_one_json_line(monkeypatch, capsys):
     monkeypatch.setattr(bench, "CONFIGS", {"train": _fake_config()})
     monkeypatch.setattr(sys, "argv", ["bench.py"])
-    assert bench.main() is None
+    assert bench.main() == 0          # 2 = regression-gate red, 3 = killed
     out = capsys.readouterr().out.strip().splitlines()
     assert len(out) == 1, out
     line = json.loads(out[0])
@@ -119,7 +119,7 @@ def test_main_budget_trims_later_configs_but_still_prints(monkeypatch,
                         {"train": slow_cfg, "extra": _fake_config()})
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     monkeypatch.setenv("MMLSPARK_BENCH_BUDGET_S", "0.01")
-    assert bench.main() is None
+    assert bench.main() == 0
     line = json.loads(capsys.readouterr().out.strip())
     # first config always runs; the over-budget one is skipped, visibly
     assert line["configs"]["train"]["value"] == 7.0

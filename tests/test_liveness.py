@@ -107,7 +107,8 @@ def test_watchdog_detects_stall_within_timeout_and_dumps_stacks(tmp_path):
     dog.close()
 
 
-def test_watchdog_abort_action_requests_preemption():
+def test_watchdog_abort_action_requests_preemption(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)       # the stall dumps the flight recorder
     clock = {"t": 0.0}
     watchdog.set_clock(lambda: clock["t"])
     hb = watchdog.register("wedged.stage")
@@ -131,7 +132,8 @@ def test_watchdog_zero_timeout_disables_detection():
     dog.close()
 
 
-def test_heartbeat_timeout_override_and_context_manager():
+def test_heartbeat_timeout_override_and_context_manager(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)       # the stall dumps the flight recorder
     clock = {"t": 0.0}
     watchdog.set_clock(lambda: clock["t"])
     dog = Watchdog(stall_timeout_s=100.0, start=False)

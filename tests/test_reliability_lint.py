@@ -239,6 +239,57 @@ def test_accepts_signal_signal_with_marker_and_non_installer_calls():
     """) == []
 
 
+def test_flags_raw_host_sync_calls():
+    probs = _problems("""
+        import jax
+
+        def fetch(x):
+            return jax.device_get(x)
+
+        def bare(x):
+            return device_get(x)
+
+        def wait(arr):
+            arr.block_until_ready()
+    """)
+    assert len(probs) == 3
+    assert all("uncounted host sync" in p for p in probs)
+    assert "allow-sync" in probs[0]      # the escape hatch is named
+    assert "mod.py:5" in probs[0]
+
+
+def test_accepts_counted_wrappers_and_marked_raw_syncs():
+    assert _problems("""
+        from mmlspark_tpu.observability import syncs as obssyncs
+
+        def fetch(x):
+            return obssyncs.device_get(x, "site")      # the wrapper
+
+        def wait(x):
+            return syncs.block_until_ready(x, "site")  # also the wrapper
+
+        def deliberate(x):
+            import jax
+            return jax.device_get(x)  # lint: allow-sync (bit-compare)
+
+        def unrelated(obj):
+            obj.get()                  # different name entirely
+    """) == []
+
+
+def test_accepts_raw_syncs_in_the_accounting_home():
+    src = textwrap.dedent("""
+        import jax
+
+        def device_get(x, site):
+            return jax.device_get(x)
+    """)
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/observability/syncs.py") == []
+    assert lint.check_source(
+        src, filename="C:\\x\\mmlspark_tpu\\observability\\syncs.py") == []
+
+
 def test_syntax_error_is_reported_not_crashing(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text("def broken(:\n")

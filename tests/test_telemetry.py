@@ -92,12 +92,19 @@ def test_writer_follows_path_change(tmp_path, registry):
 
 # ---------------------------------------------------------------- spans
 def test_disabled_span_is_shared_noop_singleton():
-    assert not events.events_enabled()
-    s = span("fit", "Anything")
-    assert s is _NOOP
-    assert span("transform") is s  # no per-call allocation
-    with s:
-        pass  # usable as a context manager
+    # the flight recorder (on by default) also records spans; the
+    # zero-allocation path requires ALL sinks off
+    config.set("observability.flight_recorder_size", 0)
+    try:
+        assert not events.events_enabled()
+        assert not events.recording_enabled()
+        s = span("fit", "Anything")
+        assert s is _NOOP
+        assert span("transform") is s  # no per-call allocation
+        with s:
+            pass  # usable as a context manager
+    finally:
+        config.unset("observability.flight_recorder_size")
 
 
 def test_span_emits_name_duration_and_nesting(events_file):
