@@ -1316,6 +1316,142 @@ def config_serving() -> dict:
             "p50_ms": round(pct(50), 3), "p99_ms": round(pct(99), 3)}
 
 
+# -- config "serving_fleet": replica router under failover -------------------
+
+def config_serving_fleet() -> dict:
+    """Fleet serving resilience: closed-loop clients through the
+    health-checked replica router (docs/SERVING.md), measured twice on
+    fresh fleets — steady state, and the SAME workload with one replica
+    killed without drain once half the requests have completed. The
+    steady pass is the headline (requests/sec through the router, p50/
+    p99); the killed pass reports degraded throughput/latency plus the
+    resilience facts the chaos harness asserts (zero failed requests,
+    failovers observed). ``kill_degradation`` is steady/killed
+    throughput — the price of losing a third of the fleet mid-run, which
+    the regression gate tracks once a baseline records it."""
+    import threading as _threading
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.reliability.retry import RetryPolicy
+    from mmlspark_tpu.serve import Fleet, Server
+
+    n, dim, bs, clients, replicas = 384, 32, 32, 16, 3
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(n, dim)).astype(np.float32)
+    jm = JaxModel(inputCol="x", outputCol="y")
+    jm.set_model("mlp_tabular", input_dim=dim, hidden=[64],
+                 num_classes=10, seed=0)
+    # the client rides out sheds AND failover-exhausted errors, exactly
+    # like a production caller; zero jitter keeps the lane deterministic
+    retry = RetryPolicy(max_attempts=4, base_delay=0.0, jitter=0.0,
+                        name="bench.fleet")
+
+    def run_pass(kill: bool):
+        fleet = Fleet({"mlp": jm}, replicas=replicas,
+                      server_kwargs=dict(max_batch=bs, max_wait_ms=1.0,
+                                         queue_depth=4 * n,
+                                         buckets=(1, 8, bs)))
+        lats: list = []
+        errs: list = []
+        done = _threading.Event()
+
+        def client(rows):
+            for i in rows:
+                t0 = time.perf_counter()
+                try:
+                    retry.call(fleet.submit, "mlp", X[i])
+                except Exception as e:
+                    errs.append(e)
+                    return
+                lats.append(time.perf_counter() - t0)
+
+        def killer():
+            while not done.is_set() and len(lats) < n // 2:
+                time.sleep(0.001)
+            if not done.is_set():
+                fleet.kill(0)
+
+        try:
+            # warm every replica's buckets OUTSIDE the timed region: the
+            # per-bucket AOT compile is a fresh-fleet setup cost, not
+            # router throughput
+            for srv in fleet.servers:
+                srv.submit("mlp", X[0])
+                srv.submit("mlp", X[:8])
+                srv.submit("mlp", X[:bs])
+            kt = None
+            if kill:
+                kt = _threading.Thread(target=killer, daemon=True)
+                kt.start()
+            t0 = time.perf_counter()
+            threads = [_threading.Thread(target=client,
+                                         args=(range(c, n, clients),),
+                                         daemon=True)
+                       for c in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            done.set()
+            if kt is not None:
+                kt.join()
+            stats = fleet.stats()
+        finally:
+            fleet.close()
+        if errs:
+            raise errs[0]
+        return elapsed, sorted(lats), stats
+
+    def run_single() -> float:
+        # baseline: the same closed-loop workload against ONE plain
+        # Server with no router in front — what vs_baseline divides by
+        srv = Server({"mlp": jm}, max_batch=bs, max_wait_ms=1.0,
+                     queue_depth=4 * n, buckets=(1, 8, bs))
+
+        def client(rows):
+            for i in rows:
+                retry.call(srv.submit, "mlp", X[i])
+
+        try:
+            srv.submit("mlp", X[0])
+            srv.submit("mlp", X[:8])
+            srv.submit("mlp", X[:bs])
+            t0 = time.perf_counter()
+            threads = [_threading.Thread(target=client,
+                                         args=(range(c, n, clients),),
+                                         daemon=True)
+                       for c in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return time.perf_counter() - t0
+        finally:
+            srv.close()
+
+    def pct(srt: list, p: float) -> float:
+        if not srt:
+            return 0.0
+        return srt[min(len(srt) - 1,
+                       int(round(p / 100.0 * (len(srt) - 1))))] * 1e3
+
+    run_pass(kill=False)   # process warmup (thread pools, shared jit)
+    t_single = run_single()
+    t_steady, lat_s, _ = run_pass(kill=False)
+    t_killed, lat_k, stats_k = run_pass(kill=True)
+    shed = sum(int(s.get("shed", 0)) for s in stats_k["servers"].values())
+    return {"value": round(n / t_steady, 2), "unit": "requests/sec/chip",
+            "vs_baseline": round(t_single / t_steady, 4),
+            "p50_ms": round(pct(lat_s, 50), 3),
+            "p99_ms": round(pct(lat_s, 99), 3),
+            "killed_rps": round(n / t_killed, 2),
+            "killed_p50_ms": round(pct(lat_k, 50), 3),
+            "killed_p99_ms": round(pct(lat_k, 99), 3),
+            "kill_degradation": round(t_killed / t_steady, 4),
+            "failovers": int(stats_k["failovers"]), "shed": shed,
+            "replicas": replicas, "served_after_kill": len(lat_k)}
+
+
 def config_streaming_input():
     """Streamed-from-disk epoch vs fully-materialized-Frame epoch.
 
@@ -1394,6 +1530,7 @@ CONFIGS = {
     "vit_preprocess": config_vit_preprocess,
     "image_featurize": config_image_featurize,
     "serving": config_serving,
+    "serving_fleet": config_serving_fleet,
     "streaming_input": config_streaming_input,
 }
 
@@ -1403,6 +1540,7 @@ CONFIG_UNITS = {
     "text": "rows/sec/chip",
     "longctx": "tokens/sec/chip",
     "serving": "requests/sec/chip",
+    "serving_fleet": "requests/sec/chip",
     "streaming_input": "rows/sec",
 }
 

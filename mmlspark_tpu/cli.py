@@ -331,14 +331,30 @@ def cmd_serve(args, passthrough) -> int:
         models[name] = m
     buckets = [int(b) for b in args.buckets.split(",") if b.strip()] \
         if args.buckets else None
-    server = Server(models, max_batch=args.max_batch,
-                    max_wait_ms=args.max_wait_ms,
-                    queue_depth=args.queue_depth, buckets=buckets)
-    httpd, addr = serve_http(server, host=args.host, port=args.port)
+    server_kwargs = dict(max_batch=args.max_batch,
+                         max_wait_ms=args.max_wait_ms,
+                         queue_depth=args.queue_depth, buckets=buckets)
+    fleet = None
+    if args.replicas > 1:
+        # fleet mode: N in-process replicas behind the health-checked
+        # router (failover, fairness, rolling rollout; docs/SERVING.md)
+        from mmlspark_tpu.serve.fleet import Fleet
+        fleet = Fleet(models, replicas=args.replicas,
+                      server_kwargs=server_kwargs)
+        fleet.router.start_prober()
+        front = fleet.router
+    else:
+        server = Server(models, **server_kwargs)
+        front = server
+    httpd, addr = serve_http(front, host=args.host, port=args.port)
     # stdout contract: one JSON line announcing the bound address, so
-    # wrappers can discover an ephemeral --port 0
+    # wrappers can discover an ephemeral --port 0; liveness and readiness
+    # are reported SEPARATELY (the /livez vs /readyz split)
+    h = front.health()
     print(json.dumps({"serving": addr,                 # lint: allow-print
-                      "models": server.registry.names()}))
+                      "models": front.registry.names(),
+                      "replicas": args.replicas,
+                      "live": h["live"], "ready": h["ready"]}))
     # graceful preemption: SIGTERM/SIGINT flip the process-wide signal;
     # this monitor turns it into drain (stop admission, finish in-flight)
     # then unblocks serve_forever. Handlers only install on the main
@@ -349,7 +365,11 @@ def cmd_serve(args, passthrough) -> int:
 
     def monitor():
         preemption.get_signal().wait()
-        server.drain(reason=preemption.preemption_reason() or "signal")
+        reason = preemption.preemption_reason() or "signal"
+        if fleet is not None:
+            fleet.drain(reason=reason)
+        else:
+            server.drain(reason=reason)
         httpd.shutdown()
 
     mon = threading.Thread(target=monitor, daemon=True,
@@ -361,24 +381,35 @@ def cmd_serve(args, passthrough) -> int:
         pass  # clean Ctrl-C shutdown path (no handler installed off-main)
     finally:
         httpd.server_close()
-        server.close()
+        if fleet is not None:
+            fleet.close()
+        else:
+            server.close()
         if watchdog is not None:
             watchdog.close()
     return 0
 
 
 def cmd_chaos(args, passthrough) -> int:
-    """Seeded chaos scenario (docs/RELIABILITY.md): train under a
-    deterministic fault schedule generated from --seed, kill + resume to
-    bit-identical params, then serve traffic under injected faults while
-    polling /healthz. Writes ``chaos_verdict.json`` under --out; exit 0
-    iff every invariant held."""
+    """Seeded chaos scenario (docs/RELIABILITY.md). ``--scenario train``
+    (default): train under a deterministic fault schedule generated from
+    --seed, kill + resume to bit-identical params, then serve traffic
+    under injected faults while polling /healthz. ``--scenario fleet``:
+    kill a replica of an N-wide fleet under fire; zero dropped requests,
+    scores bit-identical to a single server, deterministic schedule.
+    Writes ``chaos_verdict.json`` under --out; exit 0 iff every
+    invariant held."""
     from mmlspark_tpu.reliability import chaos
     outdir = args.out or os.path.join(
-        os.getcwd(), f"chaos-seed{args.seed}")
-    verdict = chaos.run_scenario(
-        args.seed, outdir, total_steps=args.steps,
-        save_every=args.save_every, requests=args.requests)
+        os.getcwd(), f"chaos-{args.scenario}-seed{args.seed}")
+    if args.scenario == "fleet":
+        verdict = chaos.run_fleet_scenario(
+            args.seed, outdir, replicas=args.replicas,
+            requests=args.requests)
+    else:
+        verdict = chaos.run_scenario(
+            args.seed, outdir, total_steps=args.steps,
+            save_every=args.save_every, requests=args.requests)
     # stdout contract: the verdict JSON, so wrappers don't re-read the file
     print(json.dumps(verdict, indent=2,       # lint: allow-print
                      sort_keys=True))
@@ -492,24 +523,37 @@ def main(argv: Optional[List[str]] = None) -> int:
     serve_p.add_argument("--buckets", default="",
                          help='batch-shape buckets, e.g. "1,8,64" '
                          "(serving.buckets)")
+    serve_p.add_argument("--replicas", type=int, default=1,
+                         help="in-process serving replicas behind the "
+                         "fleet router (failover, health probing, "
+                         "rolling rollout; default 1 = plain server)")
     serve_p.set_defaults(fn=cmd_serve)
 
     chaos_p = sub.add_parser(
         "chaos",
-        help="seeded train-kill-resume-then-serve chaos scenario; exits "
-             "0 iff all invariants hold")
+        help="seeded chaos scenario (train-kill-resume-then-serve, or "
+             "kill-a-fleet-replica-under-fire); exits 0 iff all "
+             "invariants hold")
+    chaos_p.add_argument("--scenario", default="train",
+                         choices=["train", "fleet"],
+                         help="train: kill+resume then serve under faults; "
+                         "fleet: kill one of N replicas mid-stream "
+                         "(default: train)")
     chaos_p.add_argument("--seed", type=int, default=0,
                          help="fault-schedule seed (same seed => same "
                          "kills, same verdict)")
     chaos_p.add_argument("--out", default="",
                          help="verdict/checkpoint directory (default "
-                         "./chaos-seed<SEED>)")
+                         "./chaos-<SCENARIO>-seed<SEED>)")
     chaos_p.add_argument("--steps", type=int, default=8,
                          help="train steps in each run (default 8)")
     chaos_p.add_argument("--save-every", type=int, default=2,
                          help="checkpoint cadence in steps (default 2)")
     chaos_p.add_argument("--requests", type=int, default=12,
                          help="serve-phase request count (default 12)")
+    chaos_p.add_argument("--replicas", type=int, default=3,
+                         help="fleet width for --scenario fleet "
+                         "(default 3)")
     chaos_p.set_defaults(fn=cmd_chaos)
 
     report_p = sub.add_parser(

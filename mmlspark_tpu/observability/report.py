@@ -21,6 +21,9 @@ print where the time went —
   ``serving.request`` events (p50/p99 total latency, mean queue/pad/compute
   split, batch occupancy) plus shed/expired counts, the shed rate, and
   tail-sampled slow-request trace ids;
+- fleet: router activity from ``fleet.*`` events (failovers by replica,
+  fleet-wide sheds, tenant throttles, replica kills) and rollout progress
+  from ``rollout.*`` events (shifted/warmed replicas per model version);
 - input pipeline: per-epoch item counts and wall time from the streaming
   ``data.epoch`` events (data/pipeline.py's ``Repeat`` stage).
 
@@ -260,6 +263,50 @@ def build_report(path: str, top: int = 10,
         sv["expired"] = len(expired)
         report["serving"] = sv
 
+    # -- fleet (router + rollout) ------------------------------------------
+    fleet_ev = [e for e in events if e.get("type") == "fleet"]
+    rollout_ev = [e for e in events if e.get("type") == "rollout"]
+    if fleet_ev or rollout_ev:
+        fl: Dict[str, Any] = {}
+        failovers = [e for e in fleet_ev if e.get("name") == "failover"]
+        if failovers:
+            by_rep: Dict[str, int] = defaultdict(int)
+            for e in failovers:
+                by_rep[e.get("replica", "?")] += 1
+            fl["failovers"] = {"count": len(failovers),
+                               "by_replica": dict(sorted(by_rep.items()))}
+        all_shed = [e for e in fleet_ev if e.get("name") == "all_shed"]
+        if all_shed:
+            fl["all_shed"] = len(all_shed)
+        throttled = [e for e in fleet_ev
+                     if e.get("name") == "tenant_throttled"]
+        if throttled:
+            by_ten: Dict[str, int] = defaultdict(int)
+            for e in throttled:
+                by_ten[e.get("tenant", "?")] += 1
+            fl["tenant_throttled"] = dict(sorted(by_ten.items()))
+        killed = [e.get("replica", "?") for e in fleet_ev
+                  if e.get("name") == "replica_killed"]
+        if killed:
+            fl["replicas_killed"] = killed
+        if rollout_ev:
+            by_target: Dict[Any, Dict[str, Any]] = {}
+            for e in rollout_ev:
+                key = (e.get("model", "?"), e.get("version", "?"))
+                ro = by_target.setdefault(
+                    key, {"model": key[0], "version": key[1],
+                          "shifted": 0, "warmed": 0, "status": "deploying"})
+                if e.get("name") == "shift":
+                    ro["shifted"] += 1
+                elif e.get("name") == "warm":
+                    ro["warmed"] += 1
+                elif e.get("name") == "done":
+                    ro["status"] = "done"
+                elif e.get("name") == "abort":
+                    ro["status"] = f"aborted@{e.get('replica', '?')}"
+            fl["rollouts"] = list(by_target.values())
+        report["fleet"] = fl
+
     # -- throughput --------------------------------------------------------
     fits = [e for e in plain if e.get("name") == "train.fit"]
     step_metrics = [e for e in metrics if e.get("name") == "train.step"]
@@ -410,6 +457,32 @@ def render_report(path: str, top: int = 10) -> str:
                        f"{len(sv['slow_traces'])} [{detail}]")
         out.append(f"  shed: {sv['shed']} ({sv['shed_rate']:.1f}% of "
                    f"offered), expired: {sv['expired']}")
+        out.append("")
+
+    if "fleet" in r:
+        fl = r["fleet"]
+        out.append("fleet:")
+        if "failovers" in fl:
+            detail = ", ".join(f"{k}={v}"
+                               for k, v in fl["failovers"]["by_replica"]
+                               .items())
+            out.append(f"  failovers: {fl['failovers']['count']} "
+                       f"({detail})")
+        if fl.get("replicas_killed"):
+            out.append("  replicas killed: "
+                       + ", ".join(fl["replicas_killed"]))
+        if "all_shed" in fl:
+            out.append(f"  fleet-wide sheds (all replicas full): "
+                       f"{fl['all_shed']}")
+        if "tenant_throttled" in fl:
+            detail = ", ".join(f"{k}={v}"
+                               for k, v in fl["tenant_throttled"].items())
+            out.append(f"  tenant throttled: {detail}")
+        for ro in fl.get("rollouts", ()):
+            out.append(
+                f"  rollout {ro['model']} -> {ro['version']}: "
+                f"{ro['shifted']} replica(s) shifted, "
+                f"{ro['warmed']} warmed, {ro['status']}")
         out.append("")
 
     if "throughput" in r:

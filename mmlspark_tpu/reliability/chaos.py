@@ -240,14 +240,13 @@ def _serve_phase(seed: int, requests: int,
     polls_ok = 0
     polls_bad = 0
 
-    def poll() -> None:
+    def poll(allow=("ok", "draining")) -> None:
         nonlocal polls_ok, polls_bad
         try:
             with urllib.request.urlopen(
                     f"http://{addr}/healthz", timeout=5) as resp:
                 body = json.loads(resp.read().decode())
-                if resp.status == 200 and body.get("status") in (
-                        "ok", "draining"):
+                if resp.status == 200 and body.get("status") in allow:
                     polls_ok += 1
                 else:
                     polls_bad += 1
@@ -277,7 +276,9 @@ def _serve_phase(seed: int, requests: int,
                 poll()
     poll()
     server.drain(reason="chaos scenario complete")
-    poll()  # the endpoint must answer even after the drain
+    # the endpoint must still ANSWER after the drain; with the
+    # liveness/readiness split it now truthfully reports "closed"
+    poll(allow=("ok", "draining", "closed"))
     server.close()  # idempotence: second close is a no-op
     httpd.shutdown()
     httpd.server_close()
@@ -286,6 +287,160 @@ def _serve_phase(seed: int, requests: int,
     return {"requests": requests, "served": served,
             "injected_failures": injected, "faults": plan.triggered,
             "healthz_ok": polls_ok, "healthz_bad": polls_bad}
+
+
+# -- fleet scenario ----------------------------------------------------------
+
+def run_fleet_scenario(seed: int, outdir: str, replicas: int = 3,
+                       requests: int = 24) -> Dict[str, Any]:
+    """Kill a replica under fire; the fleet must not drop a request.
+
+    1. **reference** — the full request stream scored on a single
+       :class:`~mmlspark_tpu.serve.server.Server` over the same model:
+       the numerics ground truth.
+    2. **fleet** — the same stream through a ``replicas``-wide
+       :class:`~mmlspark_tpu.serve.fleet.Fleet`; at a seeded point
+       mid-stream one seeded replica is killed without drain (in-flight
+       work fails retryably, health goes dead). The client wraps
+       ``router.submit`` in a :class:`RetryPolicy`, exactly as a real
+       client rides out a consolidated shed.
+
+    Invariants (verdict JSON, ``outdir/chaos_verdict.json``):
+
+    - ``zero_failed_requests``  — every request eventually scored; the
+      only acceptable non-successes are sheds the retry layer absorbed;
+    - ``scores_bit_identical`` — fleet results == single-server results,
+      row for row, through the kill and the failover;
+    - ``failover_observed``    — the kill actually forced at least one
+      failover (otherwise the scenario proved nothing);
+    - ``replicas_stay_probed`` — every health probe round answered for
+      every replica (dead replicas ANSWER dead; probing never wedges).
+
+    The verdict's ``schedule`` (kill point, killed replica, per-request
+    serving replica, failover count) is a pure function of ``seed`` —
+    two same-seed runs must produce byte-identical schedules, which is
+    what the tier-1 smoke test asserts.
+    """
+    import numpy as np
+
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.reliability.retry import RetryPolicy
+    from mmlspark_tpu.serve.fleet import Fleet
+    from mmlspark_tpu.serve.server import Server
+
+    os.makedirs(outdir, exist_ok=True)
+    errors: List[str] = []
+    verdict: Dict[str, Any] = {"seed": seed, "scenario": "fleet",
+                               "replicas": replicas, "requests": requests}
+
+    rng = random.Random(seed ^ 0xF1EE7)
+    # the kill lands right after a probe round: the next probe is then a
+    # full probe-interval of submits away, and a WRR walk that long over
+    # `replicas` candidates is GUARANTEED to route onto the dead replica
+    # first — failover discovers every kill, for every seed
+    probe_every = max(4, replicas + 1)
+    kill_at = -(-rng.randint(requests // 3, (2 * requests) // 3)
+                // probe_every) * probe_every
+    kill_at = min(kill_at, max(requests - probe_every, 0))
+    kill_idx = rng.randrange(replicas)
+
+    model = JaxModel(inputCol="x", outputCol="y", miniBatchSize=8)
+    model.set_model("mlp_tabular", input_dim=_DIM, hidden=[16],
+                    num_classes=3, seed=seed & 0xFFFF)
+    xrng = np.random.default_rng(seed)
+    stream = [xrng.normal(0, 1, (2, _DIM)).astype(np.float32)
+              for _ in range(requests)]
+
+    # phase 1: single-server reference (same model object -> same programs)
+    ref_server = Server({"chaos": model}, max_batch=4, queue_depth=32)
+    try:
+        reference = [np.asarray(ref_server.submit("chaos", x, timeout=30))
+                     for x in stream]
+    finally:
+        ref_server.close()
+
+    # phase 2: the same stream through the fleet, with a seeded mid-stream
+    # kill. Sequential blocking submits keep the router's WRR walk (and so
+    # the whole schedule) deterministic.
+    fleet = Fleet({"chaos": model}, replicas=replicas,
+                  server_kwargs={"max_batch": 4, "queue_depth": 32})
+    route_log: List[str] = []
+    fleet.router.route_log = route_log
+    client_retry = RetryPolicy(max_attempts=4, base_delay=0.0, jitter=0.0,
+                               name="chaos.fleet.client", seed=seed)
+    results: List[Optional[Any]] = []
+    failed = 0
+    probe_rounds: List[Dict[str, str]] = []
+    try:
+        for i, x in enumerate(stream):
+            # probe BEFORE this round's kill: the kill must be discovered
+            # by failover (a live request landing on the dead replica),
+            # not pre-empted by a health probe in the same iteration —
+            # with the probe leading, the dead replica stays in rotation
+            # for the next few submits and the WRR walk is guaranteed to
+            # reach it before the next probe round.
+            if i % probe_every == 0:
+                probe_rounds.append(fleet.router.probe())
+            if i == kill_at:
+                fleet.kill(kill_idx)
+            try:
+                results.append(np.asarray(
+                    client_retry.call(fleet.submit, "chaos", x)))
+            except Exception as e:
+                failed += 1
+                results.append(None)
+                errors.append(
+                    f"request {i}: {type(e).__name__}: {e}")
+        probe_rounds.append(fleet.router.probe())
+        stats = fleet.stats()
+    finally:
+        fleet.close()
+
+    identical = all(
+        r is not None and np.array_equal(r, ref)
+        for r, ref in zip(results, reference))
+    probed_ok = bool(probe_rounds) and all(
+        len(round_) == replicas for round_ in probe_rounds)
+    failovers = int(stats["failovers"])
+    shed = sum(int(s.get("shed", 0))
+               for s in stats["servers"].values())
+
+    verdict["schedule"] = {
+        "kill_at": kill_at, "kill_replica": f"r{kill_idx}",
+        "route_log": route_log, "failovers": failovers,
+    }
+    verdict["fleet"] = {
+        "served": sum(1 for r in results if r is not None),
+        "failed": failed, "shed": shed,
+        "probe_rounds": len(probe_rounds),
+        "final_states": probe_rounds[-1] if probe_rounds else {},
+    }
+    invariants = {
+        "zero_failed_requests": failed == 0,
+        "scores_bit_identical": identical,
+        "failover_observed": failovers >= 1,
+        "replicas_stay_probed": probed_ok,
+        "no_unhandled_exceptions": not errors,
+    }
+    verdict["invariants"] = invariants
+    verdict["errors"] = errors
+    verdict["passed"] = all(invariants.values())
+
+    path = os.path.join(outdir, VERDICT_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(verdict, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    _LOG.info("chaos fleet verdict (%s): %s", path,
+              "PASS" if verdict["passed"] else "FAIL")
+    if not verdict["passed"]:
+        from mmlspark_tpu.observability import flightrec
+        dumped = flightrec.dump(
+            reason=f"chaos.fleet.red.seed{seed}",
+            path=os.path.join(outdir, "chaos_flightrec.jsonl"))
+        if dumped:
+            _LOG.error("chaos: flight recorder dumped to %s", dumped)
+    return verdict
 
 
 # -- the scenario ------------------------------------------------------------

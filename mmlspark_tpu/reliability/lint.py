@@ -44,6 +44,15 @@ sync accounter cannot see, which silently falsifies the ROADMAP item-4
 recognized as the wrappers); deliberate raw syncs mark the line
 ``# lint: allow-sync``.
 
+Rule 8 — direct replica calls (``<x>replica.submit/submit_async/
+submit_many/score(...)``) in ``serve/`` outside ``serve/router.py``: a
+cross-replica call that bypasses the router bypasses its circuit
+breaker, failover retry, and fairness accounting — the exact wrappers
+the fleet layer exists to enforce — so one unrouted call site quietly
+loses a request when its replica dies. All cross-replica traffic goes
+through the Router; deliberate direct calls (a rollout warming a
+drained replica) mark the line ``# lint: allow-direct-replica``.
+
 Shared core for ``tools/check_reliability.py`` (standalone CLI),
 ``mmlspark-tpu check`` (installed CLI), and the in-pytest gate
 (tests/test_reliability_lint.py) — same single source of truth pattern as
@@ -94,6 +103,11 @@ _SIGNAL_HOME = "reliability/preemption.py"
 # the ONE module allowed to call the raw blocking primitives
 _SYNC_HOME = "observability/syncs.py"
 _SYNC_CALLS = ("device_get", "block_until_ready")
+_ALLOW_REPLICA = "# lint: allow-direct-replica"
+# the ONE serve/ module allowed to call replicas directly (it IS the
+# breaker/retry wrapper layer)
+_REPLICA_HOME = "serve/router.py"
+_REPLICA_CALLS = ("submit", "submit_async", "submit_many", "score")
 
 
 def _is_raw_sync(call: ast.Call) -> bool:
@@ -111,6 +125,25 @@ def _is_raw_sync(call: ast.Call) -> bool:
             return False
         return True
     return False
+
+
+def _is_direct_replica_call(call: ast.Call) -> bool:
+    """``<recv>.submit/submit_async/submit_many/score(...)`` where the
+    receiver's terminal name mentions ``replica`` (``replica.submit``,
+    ``h.replica.submit``, ``self.replica.score``) — a raw cross-replica
+    call. Router-mediated traffic never spells the replica receiver at
+    the call site, so the name is the signal."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr in _REPLICA_CALLS):
+        return False
+    v = f.value
+    if isinstance(v, ast.Name):
+        name = v.id
+    elif isinstance(v, ast.Attribute):
+        name = v.attr
+    else:
+        return False
+    return "replica" in name.lower()
 
 
 def _is_signal_signal(call: ast.Call) -> bool:
@@ -131,6 +164,8 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     norm = str(filename).replace("\\", "/")
     signal_home = norm.endswith(_SIGNAL_HOME)
     sync_home = norm.endswith(_SYNC_HOME)
+    # Rule 8 scope: serve/ modules only (the fleet layer), router exempt
+    replica_scoped = "serve/" in norm and not norm.endswith(_REPLICA_HOME)
 
     def _allowed(lineno: int) -> bool:
         # marker anywhere on the offending line opts that line out
@@ -144,6 +179,10 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     def _sync_allowed(lineno: int) -> bool:
         return (0 < lineno <= len(lines)
                 and _ALLOW_SYNC in lines[lineno - 1])
+
+    def _replica_allowed(lineno: int) -> bool:
+        return (0 < lineno <= len(lines)
+                and _ALLOW_REPLICA in lines[lineno - 1])
 
     for node in ast.walk(tree):
         if (isinstance(node, ast.Call)
@@ -192,6 +231,14 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
                 "last-installer-wins; route through "
                 "reliability.preemption, or mark the line "
                 f"`{_ALLOW_SIGNAL}`)")
+        elif (isinstance(node, ast.Call) and replica_scoped
+                and _is_direct_replica_call(node)
+                and not _replica_allowed(node.lineno)):
+            problems.append(
+                f"{filename}:{node.lineno}: direct replica call in serve/ "
+                f"outside {_REPLICA_HOME} (bypasses the router's breaker/"
+                "failover/fairness wrappers; route through Router.submit, "
+                f"or mark the line `{_ALLOW_REPLICA}`)")
         elif (isinstance(node, ast.Call) and _is_raw_sync(node)
                 and not sync_home
                 and not _sync_allowed(node.lineno)):

@@ -1,13 +1,21 @@
 """Online serving: dynamic micro-batching inference under SLO telemetry.
 
 The request-level counterpart to ``JaxModel.transform``'s whole-frame
-scoring — see ``docs/SERVING.md`` for architecture, the ``serving.*``
-config namespace, and overload/retry semantics.
+scoring — see ``docs/SERVING.md`` for architecture, the ``serving.*`` /
+``fleet.*`` config namespaces, and overload/retry/failover semantics.
+One :class:`Server` is a replica; a :class:`Fleet` is N of them behind a
+health-checked :class:`Router` with failover, per-tenant fairness, and
+zero-downtime rolling rollout.
 """
 from mmlspark_tpu.serve.batcher import (      # noqa: F401
     MicroBatcher, Ticket, bucket_for, default_buckets, parse_buckets,
 )
+from mmlspark_tpu.serve.fleet import Fleet, InProcessReplica  # noqa: F401
 from mmlspark_tpu.serve.registry import ModelEntry, ModelRegistry  # noqa: F401
+from mmlspark_tpu.serve.router import (        # noqa: F401
+    HttpReplica, ReplicaUnavailable, Router, TenantThrottled,
+    WeightedFairAdmission,
+)
 from mmlspark_tpu.serve.server import (        # noqa: F401
     RequestExpired, ServeError, Server, ServerClosed, ServerOverloaded,
 )
@@ -16,4 +24,6 @@ __all__ = [
     "MicroBatcher", "Ticket", "bucket_for", "default_buckets",
     "parse_buckets", "ModelEntry", "ModelRegistry", "Server",
     "ServeError", "ServerOverloaded", "RequestExpired", "ServerClosed",
+    "Fleet", "InProcessReplica", "HttpReplica", "Router",
+    "ReplicaUnavailable", "TenantThrottled", "WeightedFairAdmission",
 ]

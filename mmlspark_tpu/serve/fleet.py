@@ -1,0 +1,262 @@
+"""Fleet: N serving replicas behind one router, rolled out without downtime.
+
+The deployment-unit layer above :class:`~mmlspark_tpu.serve.server.Server`
+(one process, one executor, one bounded queue) and
+:class:`~mmlspark_tpu.serve.router.Router` (spread + failover + fairness):
+
+- :class:`InProcessReplica` — a live :class:`Server` behind the Replica
+  protocol, plus ``kill()``: the chaos lever that makes a replica die the
+  way a preempted pod does (in-flight work fails retryably, subsequent
+  calls are transport-dead), so failover is exercised for real.
+- :class:`Fleet` — builds N in-process replicas over the SAME model
+  objects (they share one ``_cached_jit`` program cache: N replicas cost
+  one compile, the whole point of in-process replication on one host) and
+  fronts them with a :class:`Router`.
+- :meth:`Fleet.rollout` — the zero-downtime model-version rollout, one
+  replica at a time: **deploy** (shift the replica's router weight to 0 —
+  no new traffic, in-flight finishes) -> **drain** (wait for in-flight 0)
+  -> **swap** (:meth:`ModelRegistry.replace` — atomic cutover, old entry
+  evicted/retired) -> **warm** (build the new version's apply and
+  AOT-compile its bucket against a sample row BEFORE it takes traffic, so
+  the first real request never pays the compile) -> **shift** (restore
+  weight). The other replicas keep serving the whole time; the observable
+  trail is ``rollout.*`` events plus the report dict returned.
+
+HTTP replicas (separate serving processes) ride the same router via
+:class:`~mmlspark_tpu.serve.router.HttpReplica`; this module's Fleet is
+the single-host form the CLI (``mmlspark-tpu serve --replicas N``) and
+the chaos harness drive.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.observability import events
+from mmlspark_tpu.serve.router import ReplicaUnavailable, Router
+from mmlspark_tpu.serve.server import (
+    Server, ServerClosed, ServerOverloaded,
+)
+from mmlspark_tpu.utils import config as mmlconfig
+from mmlspark_tpu.utils.logging import get_logger
+
+logger = get_logger("serve.fleet")
+
+
+class InProcessReplica:
+    """One in-process :class:`Server` behind the Replica protocol.
+
+    ``submit`` blocks on the server's future so the router sees a plain
+    call with plain exceptions; a replica that has been :meth:`kill`-ed
+    (or whose server closed under the request) surfaces as
+    :class:`ReplicaUnavailable` — the transport-dead signal the router's
+    failover path keys on, distinct from a shed (the server answering
+    "full")."""
+
+    def __init__(self, name: str, server: Server):
+        self.name = name
+        self.server = server
+        self._dead = False
+
+    @property
+    def capacity_rows(self) -> int:
+        return self.server.capacity_rows
+
+    def submit(self, model: str, x, deadline_ms: Optional[float] = None,
+               trace_id: str = "") -> np.ndarray:
+        if self._dead:
+            raise ReplicaUnavailable(f"replica {self.name} is dead")
+        try:
+            fut = self.server.submit_async(model, x, deadline_ms,
+                                           trace_id=trace_id or None)
+            return fut.result()
+        except ServerClosed as e:
+            raise ReplicaUnavailable(
+                f"replica {self.name} closed") from e
+        except ServerOverloaded as e:
+            if self._dead or not self.server.health()["live"]:
+                # the kill landed while this request was in flight: its
+                # ticket failed retryably, but for the ROUTER this is a
+                # dying replica, not a full one — failover, don't shed
+                raise ReplicaUnavailable(
+                    f"replica {self.name} died mid-request") from e
+            raise
+
+    def health(self) -> Dict[str, object]:
+        if self._dead:
+            return {"live": False, "ready": False, "state": "dead"}
+        return self.server.health()
+
+    def models(self) -> List[str]:
+        return self.server.registry.names()
+
+    def kill(self) -> None:
+        """Die like a preempted pod: no drain, in-flight tickets fail
+        retryably ("retry elsewhere"), health goes dead. Idempotent."""
+        if self._dead:
+            return
+        self._dead = True
+        logger.warning("replica %s killed", self.name)
+        if events.recording_enabled():
+            events.emit("fleet", "replica_killed", replica=self.name)
+        self.server.close(drain=False, timeout_s=0.5)
+
+
+class Fleet:
+    """N in-process replicas + router + rolling rollout, one object.
+
+    ``models`` maps serving names to fitted models, exactly as
+    :class:`Server` takes them; every replica registers the SAME model
+    objects, so the jit/program caches are shared and N replicas compile
+    once. Server knobs (``queue_depth``, ``max_batch``, ...) pass through
+    to every replica; router knobs (``failover_attempts``,
+    ``tenant_weights``, ...) to the router. ``clock``/``sleep`` are
+    injectable for deterministic tests and reach both layers.
+    """
+
+    def __init__(self, models: Dict[str, object], *,
+                 replicas: Optional[int] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 sleep: Optional[Callable[[float], None]] = None,
+                 start: bool = True,
+                 server_kwargs: Optional[Dict] = None,
+                 **router_kwargs):
+        n = int(replicas if replicas is not None
+                else mmlconfig.get("fleet.replicas"))
+        if n < 1:
+            raise ValueError(f"fleet needs >= 1 replica, got {n}")
+        self._sleep = sleep if sleep is not None else time.sleep
+        skw = dict(server_kwargs or {})
+        skw.setdefault("clock", clock)
+        self.servers = [Server(models, start=start, **skw)
+                        for _ in range(n)]
+        self.replicas = [InProcessReplica(f"r{i}", srv)
+                         for i, srv in enumerate(self.servers)]
+        self.router = Router(self.replicas, clock=clock, sleep=sleep,
+                             **router_kwargs)
+        self._closed = False
+
+    # -- serving surface (delegates; the HTTP front-end binds the router) --
+    def submit(self, model: str, x, deadline_ms: Optional[float] = None,
+               **kw) -> np.ndarray:
+        return self.router.submit(model, x, deadline_ms, **kw)
+
+    def health(self) -> Dict[str, object]:
+        return self.router.health()
+
+    def stats(self) -> Dict[str, object]:
+        s = self.router.stats()
+        s["servers"] = {r.name: r.server.stats() for r in self.replicas}
+        return s
+
+    def kill(self, index: int) -> None:
+        """Chaos lever: kill replica ``index`` without telling the router
+        — failover and health probing must DISCOVER the death."""
+        self.replicas[index].kill()
+
+    # -- rolling rollout ----------------------------------------------------
+    def rollout(self, name: str, model, version: str,
+                warm_x=None,
+                drain_timeout_s: Optional[float] = None) -> Dict:
+        """Roll ``name`` to ``model``@``version`` across the fleet with
+        zero downtime: one replica at a time leaves rotation, drains,
+        swaps, warms, and returns — the rest keep serving throughout.
+
+        ``warm_x`` (a sample row or batch) makes the warm step score once
+        through the replica BEFORE it takes traffic, building the apply
+        AND AOT-compiling its bucket; without it the warm step only
+        builds the apply (the first request pays bucket compilation).
+        The first replica is the canary: its warm failure aborts the
+        rollout with every other replica still on the old version."""
+        timeout = float(drain_timeout_s if drain_timeout_s is not None
+                        else mmlconfig.get("serving.drain_timeout_s"))
+        report: Dict = {"model": name, "version": version, "replicas": []}
+        if events.recording_enabled():
+            events.emit("rollout", "deploy", model=name, version=version,
+                        replicas=len(self.replicas))
+        for rep in self.replicas:
+            if rep._dead:
+                report["replicas"].append(
+                    {"replica": rep.name, "status": "skipped_dead"})
+                continue
+            step = {"replica": rep.name, "status": "updated"}
+            weight = self.router._handles[rep.name].weight
+            # deploy: out of rotation — no NEW traffic; in-flight finishes
+            self.router.set_weight(rep.name, 0.0)
+            try:
+                self._wait_idle(rep.server, timeout)
+                entry = rep.server.registry.replace(name, model, version)
+                self._warm(rep, entry, name, warm_x)
+            except Exception:
+                # canary semantics: put the replica back in rotation on
+                # whatever version its registry now holds, then abort —
+                # replicas not yet touched still serve the old version
+                self.router.set_weight(rep.name, weight)
+                if events.recording_enabled():
+                    events.emit("rollout", "abort", model=name,
+                                version=version, replica=rep.name)
+                raise
+            # shift: warmed replica takes traffic again
+            self.router.set_weight(rep.name, weight)
+            if events.recording_enabled():
+                events.emit("rollout", "shift", model=name,
+                            version=version, replica=rep.name,
+                            weight=weight)
+            report["replicas"].append(step)
+        if events.recording_enabled():
+            events.emit("rollout", "done", model=name, version=version,
+                        updated=sum(1 for r in report["replicas"]
+                                    if r["status"] == "updated"))
+        report["versions"] = {r.name: r.server.registry.versions()
+                              for r in self.replicas if not r._dead}
+        return report
+
+    def _wait_idle(self, server: Server, timeout_s: float) -> None:
+        """Drain: wait for the replica's in-flight count to hit zero
+        (admission continues — only the ROUTER stopped sending; a direct
+        client could still reach it, which is fine: rollout waits)."""
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        while server.inflight > 0:
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"replica did not drain within {timeout_s:.1f}s "
+                    f"({server.inflight} in flight)")
+            self._sleep(0.005)
+
+    def _warm(self, rep: InProcessReplica, entry, name: str,
+              warm_x) -> None:
+        """Warm the swapped entry before it takes traffic: build the
+        apply (device-resident params), and when a sample is given score
+        it end to end so the bucket's program is AOT-compiled."""
+        entry.ensure_apply()
+        if warm_x is not None:
+            rep.submit(name, warm_x)  # lint: allow-direct-replica
+        if events.recording_enabled():
+            events.emit("rollout", "warm", model=name,
+                        version=entry.version, replica=rep.name,
+                        warmed=warm_x is not None)
+
+    # -- lifecycle ----------------------------------------------------------
+    def drain(self, reason: str = "drain") -> None:
+        """Fleet-wide graceful drain (preemption): every live replica
+        stops admission, finishes in-flight work, and closes."""
+        for rep in self.replicas:
+            if not rep._dead:
+                rep.server.drain(reason=reason)
+
+    def close(self, drain: bool = True) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.router.close()
+        for rep in self.replicas:
+            if not rep._dead:
+                rep.server.close(drain=drain)
+
+    def __enter__(self) -> "Fleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

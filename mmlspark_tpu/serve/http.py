@@ -13,8 +13,15 @@ JSON in, JSON out, zero new dependencies — the transport half of
   HTTP-native "retryable" signal — ``default_retryable`` already treats
   5xx as retryable on the client side), ``RequestExpired`` -> **504**,
   unknown model / malformed body -> **400**.
-- ``GET /healthz`` — liveness + :meth:`Server.stats`.
-- ``GET /models`` — registered model names.
+- ``GET /healthz`` — liveness AND readiness in one body
+  (``{"status", "live", "ready", "state", "stats"}``): a draining server
+  is still ``live`` (in-flight work finishes) but not ``ready`` (stop
+  sending traffic) — the split the fleet router routes on.
+- ``GET /livez`` / ``GET /readyz`` — the k8s-style probe pair: ``/livez``
+  is 200 while the process serves its in-flight work (even draining);
+  ``/readyz`` turns 503 the moment admission stops, so a load balancer
+  rotates the replica out BEFORE it dies.
+- ``GET /models`` — registered model names (+ served versions).
 - ``GET /metrics`` — Prometheus text exposition of the process registry.
 
 ``ThreadingHTTPServer`` gives one thread per connection; they all funnel
@@ -42,6 +49,14 @@ logger = get_logger("serve.http")
 MAX_BODY_BYTES = 64 * 1024 * 1024   # one request never buffers more
 
 
+def _fmt_after(seconds: float) -> str:
+    """Retry-After header value: integral seconds render as delta-seconds
+    per RFC 7231 ("0", "1"); sub-second asks keep the decimal — our own
+    clients (HttpReplica, the retry layer) parse floats."""
+    s = float(seconds)
+    return str(int(s)) if s.is_integer() else str(s)
+
+
 def make_handler(server: Server):
     """Handler class bound to one :class:`Server` (stdlib handlers are
     instantiated per request; the closure carries the server)."""
@@ -65,14 +80,26 @@ def make_handler(server: Server):
 
         def do_GET(self):
             if self.path == "/healthz":
-                # a draining server is still LIVE (in-flight work finishes,
-                # /healthz answers) but no longer ready for new traffic —
-                # load balancers read "draining" and rotate it out
-                status = "draining" if server.draining else "ok"
-                self._reply(200, {"status": status,
+                # liveness and readiness, split: a draining server is
+                # still LIVE (in-flight work finishes, /healthz answers)
+                # but no longer READY for new traffic — routers read
+                # "draining" and rotate it out before it stops being alive
+                h = server.health()
+                status = "ok" if h["ready"] else h["state"]
+                self._reply(200, {"status": status, **h,
                                   "stats": server.stats()})
+            elif self.path == "/livez":
+                h = server.health()
+                self._reply(200 if h["live"] else 503, h)
+            elif self.path == "/readyz":
+                h = server.health()
+                self._reply(200 if h["ready"] else 503, h)
             elif self.path == "/models":
-                self._reply(200, {"models": server.registry.names()})
+                reg = server.registry
+                payload = {"models": reg.names()}
+                if hasattr(reg, "versions"):
+                    payload["versions"] = reg.versions()
+                self._reply(200, payload)
             elif self.path == "/metrics":
                 text = metrics.get_registry().prometheus_text()
                 body = text.encode("utf-8")
@@ -98,13 +125,17 @@ def make_handler(server: Server):
                 model = req["model"]
                 x = np.asarray(req["x"])
                 deadline_ms = req.get("deadline_ms")
+                # a fleet router threads its trace_id through so one id
+                # correlates the whole failover chain across replicas
+                rid = str(req.get("trace_id") or "") or None
             except (KeyError, ValueError, TypeError) as e:
                 self._reply(400, {"error": f"bad request: {e}"})
                 return
             trace_id = ""
             try:
                 if x.ndim <= 1:
-                    fut = server.submit_async(model, x, deadline_ms)
+                    fut = server.submit_async(model, x, deadline_ms,
+                                              trace_id=rid)
                     trace_id = getattr(fut, "trace_id", "")
                     y = fut.result()
                 else:
@@ -112,11 +143,15 @@ def make_handler(server: Server):
                     # single id to return
                     y = server.submit_many(model, x, deadline_ms)
             except ServerOverloaded as e:
-                # Retry-After: 1 while draining (this replica is going
-                # away — come back to the pool, not instantly to us)
-                after = "1" if server.draining else "0"
-                self._reply(503, {"error": str(e), "retryable": True},
-                            headers={"Retry-After": after})
+                # Retry-After carries the server's own ask (a draining
+                # replica says 1s — come back to the pool, not instantly
+                # to us; a full queue says serving.retry_after_s)
+                after = getattr(e, "retry_after", None)
+                if after is None:
+                    after = 1.0 if server.draining else 0.0
+                self._reply(503, {"error": str(e), "retryable": True,
+                                  "retry_after": after},
+                            headers={"Retry-After": _fmt_after(after)})
             except ServerClosed as e:
                 self._reply(503, {"error": str(e), "retryable": True},
                             headers={"Retry-After": "1"})

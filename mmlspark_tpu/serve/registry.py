@@ -43,9 +43,10 @@ def _param_bytes(params) -> int:
 class ModelEntry:
     """One served model: coercion spec, bound apply, per-bucket programs."""
 
-    def __init__(self, name: str, model):
+    def __init__(self, name: str, model, version: str = "v1"):
         self.name = name
         self.model = model
+        self.version = version
         self._spec = model._spec()
         self._apply = None
         self._compiled: Dict[Tuple, Callable] = {}
@@ -165,13 +166,29 @@ class ModelRegistry:
             mb = float(mmlconfig.get("runtime.device_cache_mb"))
         return mb * 1e6
 
-    def add(self, name: str, model) -> ModelEntry:
+    def add(self, name: str, model, version: str = "v1") -> ModelEntry:
         with self._lock:
             if name in self._entries:
                 raise ValueError(f"model {name!r} already registered")
-            entry = ModelEntry(name, model)
+            entry = ModelEntry(name, model, version=version)
             self._entries[name] = entry
             return entry
+
+    def replace(self, name: str, model, version: str) -> ModelEntry:
+        """Atomically swap the entry behind ``name`` (the rollout
+        cutover): lookups from the swap onward get the new version; a
+        batch already holding the OLD entry finishes on it (that request
+        was admitted pre-cutover). The old entry is evicted so its
+        compiled programs and params become collectable — "retire old"
+        in the rollout sequence. Unknown names register fresh (a rollout
+        may introduce a model)."""
+        with self._lock:
+            old = self._entries.pop(name, None)
+            entry = ModelEntry(name, model, version=version)
+            self._entries[name] = entry
+        if old is not None and old.warm:
+            old.evict()
+        return entry
 
     def get(self, name: str) -> ModelEntry:
         with self._lock:
@@ -207,6 +224,11 @@ class ModelRegistry:
     def resident_bytes(self) -> int:
         with self._lock:
             return self._resident()
+
+    def versions(self) -> Dict[str, str]:
+        """Name -> served version (the rollout observability surface)."""
+        with self._lock:
+            return {n: e.version for n, e in sorted(self._entries.items())}
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

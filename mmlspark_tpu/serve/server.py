@@ -76,8 +76,18 @@ class ServerOverloaded(ServeError):
     """Admission rejected: the bounded queue is full. Retryable by
     contract — ``reliability.retry.default_retryable`` reads this class
     attribute, so a client wrapping ``submit`` in ``RetryPolicy`` backs
-    off and retries without custom classification."""
+    off and retries without custom classification.
+
+    ``retry_after`` (seconds, or None) is the server's backoff ask: the
+    HTTP front-end maps it to the ``Retry-After`` header, the retry layer
+    reads it through the ``retry_after`` attribute protocol, and the
+    fleet router consolidates the MINIMUM across replicas when every
+    replica sheds (come back when the soonest one frees up)."""
     retryable = True
+
+    def __init__(self, msg: str, retry_after: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after = retry_after
 
 
 class RequestExpired(ServeError):
@@ -88,6 +98,27 @@ class RequestExpired(ServeError):
 
 class ServerClosed(ServeError):
     """Submitted to a server after ``close()``."""
+
+
+class _Twin:
+    """A per-instance counter that also feeds the process-wide metric of
+    the same name: ``value`` is THIS server's count (stats()/inflight for
+    one fleet replica), the registry counter stays the process aggregate
+    the exposition endpoint and existing dashboards read."""
+
+    __slots__ = ("_local", "_global")
+
+    def __init__(self, name: str):
+        self._local = metrics.Counter(name)
+        self._global = metrics.counter(name)
+
+    def inc(self, n: float = 1.0) -> None:
+        self._local.inc(n)
+        self._global.inc(n)
+
+    @property
+    def value(self) -> float:
+        return self._local.value
 
 
 class Server:
@@ -140,11 +171,14 @@ class Server:
         self._draining = False
         self._thread: Optional[threading.Thread] = None
         # counters are unconditional (lock + int add); gauges/histograms
-        # gate per-use on metrics_enabled()
-        self._admitted = metrics.counter("serving.admitted")
-        self._shed = metrics.counter("serving.shed")
-        self._expired = metrics.counter("serving.expired")
-        self._completed = metrics.counter("serving.completed")
+        # gate per-use on metrics_enabled(). The metrics registry is
+        # process-wide — with N in-process fleet replicas those counters
+        # aggregate — so per-instance Counter twins back stats()/inflight.
+        self._admitted = _Twin("serving.admitted")
+        self._shed = _Twin("serving.shed")
+        self._expired = _Twin("serving.expired")
+        self._completed = _Twin("serving.completed")
+        self._failed = _Twin("serving.failed")
         if start:
             self.start()
 
@@ -191,8 +225,10 @@ class Server:
                 leftovers.extend(self._batcher.take())
             for t in leftovers:
                 if not t.future.done():
+                    self._failed.inc()
                     t.future.set_exception(ServerOverloaded(
-                        "server closed before scoring; retry elsewhere"))
+                        "server closed before scoring; retry elsewhere",
+                        retry_after=1.0))
         if events.events_enabled():
             s = self.stats()
             events.emit("serving", "summary", **s)
@@ -223,6 +259,37 @@ class Server:
     def draining(self) -> bool:
         return self._draining and not self._closed
 
+    def health(self) -> Dict[str, object]:
+        """Liveness vs readiness, split (the k8s-probe distinction the
+        fleet router routes on): a DRAINING server is still ``live`` —
+        in-flight work finishes, ``/healthz`` answers — but no longer
+        ``ready`` for new traffic, so the router rotates it out BEFORE it
+        stops being alive. ``state`` is one of ``ready``/``draining``/
+        ``closed``."""
+        if self._closed:
+            state = "closed"
+        elif self._draining:
+            state = "draining"
+        else:
+            state = "ready"
+        return {"live": not self._closed, "ready": state == "ready",
+                "state": state}
+
+    @property
+    def inflight(self) -> int:
+        """Requests admitted but not yet resolved (completed, expired, or
+        failed) — the rollout drain condition."""
+        n = self._admitted.value - self._completed.value \
+            - self._expired.value - self._failed.value
+        return max(0, int(round(n)))
+
+    @property
+    def capacity_rows(self) -> int:
+        """Admission headroom (the bounded-queue depth, i.e. in-flight
+        requests this replica holds before shedding): the fleet fairness
+        layer sizes tenant shares from the sum of replica capacities."""
+        return self._queue.maxsize
+
     def __enter__(self) -> "Server":
         return self
 
@@ -231,15 +298,19 @@ class Server:
 
     # -- submission (caller threads) --------------------------------------
     def submit_async(self, model: str, x,
-                     deadline_ms: Optional[float] = None) -> Future:
+                     deadline_ms: Optional[float] = None, *,
+                     trace_id: Optional[str] = None) -> Future:
         """Admit one request (a single example or a small batch of rows up
         to ``max_batch``); returns a Future resolving to the scored rows
         (float32, one row per input row). Raises :class:`ServerOverloaded`
-        synchronously when the queue is full."""
+        synchronously when the queue is full. ``trace_id`` lets a fleet
+        router thread ONE id through a failover chain — when None the
+        server mints its own."""
         if self._closed:
             raise ServerClosed("server closed")
         if self._draining:
-            raise ServerOverloaded("server draining; retry elsewhere")
+            raise ServerOverloaded("server draining; retry elsewhere",
+                                   retry_after=1.0)
         entry = self.registry.get(model)   # KeyError surfaces here, early
         arr = np.asarray(x)
         if arr.ndim == 1:
@@ -256,7 +327,7 @@ class Server:
         deadline = now + deadline_ms / 1e3 if deadline_ms else None
         ticket = Ticket(model, coerced, coerced.shape[0], Future(),
                         enqueued=now, deadline=deadline,
-                        trace_id=_mint_trace_id())
+                        trace_id=trace_id or _mint_trace_id())
         # callers (the HTTP front-end) read the id off the future they
         # already hold — no parallel return channel needed
         ticket.future.trace_id = ticket.trace_id
@@ -272,7 +343,8 @@ class Server:
                     raise ServerClosed("server closed")
                 if self._draining:
                     raise ServerOverloaded(
-                        "server draining; retry elsewhere")
+                        "server draining; retry elsewhere",
+                        retry_after=1.0)
                 self._queue.put_nowait(ticket)
         except queue.Full:
             self._shed.inc()
@@ -281,7 +353,9 @@ class Server:
                             rows=ticket.rows, trace_id=ticket.trace_id)
             raise ServerOverloaded(
                 f"queue full ({self._queue.maxsize} pending); retry with "
-                "backoff") from None
+                "backoff",
+                retry_after=float(
+                    mmlconfig.get("serving.retry_after_s"))) from None
         self._admitted.inc()
         if metrics.metrics_enabled():
             metrics.gauge("serving.queue_depth").set(self._queue.qsize())
@@ -401,6 +475,7 @@ class Server:
             logger.error("serve batch failed: %s", e)
             for t in live:
                 if not t.future.done():
+                    self._failed.inc()
                     t.future.set_exception(e)
 
     def _respond(self, live: List[Ticket], out: np.ndarray, bucket: int,
@@ -487,6 +562,8 @@ class Server:
              "shed": self._shed.value,
              "expired": self._expired.value,
              "completed": self._completed.value,
+             "failed": self._failed.value,
+             "inflight": self.inflight,
              "queue_depth": self._queue.qsize(),
              "pending_rows": self._batcher.pending_rows}
         s.update({f"registry.{k}": v

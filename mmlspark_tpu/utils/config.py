@@ -54,6 +54,19 @@ _DEFAULTS: Dict[str, Any] = {
                                       # e.g. "1,8,64" (largest >= max_batch)
     "serving.default_deadline_ms": 0.0,  # 0 = requests never expire
     "serving.drain_timeout_s": 10.0,  # graceful-drain budget before close
+    "serving.retry_after_s": 0.0,     # Retry-After hint on a queue-full
+                                      # shed (draining replicas hint 1.0)
+    # fleet (multi-replica router + rolling rollout; see docs/SERVING.md)
+    "fleet.replicas": 2,              # in-process replicas per Fleet
+    "fleet.failover_attempts": 2,     # routing tries per request (1 = no
+                                      # failover; 2 = one retry elsewhere)
+    "fleet.failover_delay_s": 0.0,    # backoff between failover attempts
+    "fleet.probe_interval_s": 1.0,    # background health-probe cadence
+    "fleet.capacity_rows": 0,         # tenant-fairness capacity (0 =
+                                      # derive from replica queue depths)
+    "fleet.tenant_weights": "",       # "gold=3,free=1"; unlisted tenants
+                                      # get fleet.tenant_default_weight
+    "fleet.tenant_default_weight": 1.0,
     # logging
     "logging.level": "INFO",
     "logging.metrics_every": 0,       # default train-metric log cadence (steps)

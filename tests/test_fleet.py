@@ -1,0 +1,535 @@
+"""Fleet serving (serve/router.py + serve/fleet.py): health-checked
+replica routing with failover, per-tenant fairness, zero-downtime
+rollout.
+
+Everything runs on CPU with injected clocks or real sub-second
+concurrency — no sleeps in assertions. The acceptance spine:
+
+- smooth weighted round-robin is deterministic (the chaos schedule
+  depends on it) and honours ``set_weight`` as the rollout traffic lever;
+- a replica dying mid-request fails over EXACTLY once onto a healthy
+  replica with the same ``trace_id`` and the REMAINING deadline budget
+  (satellite: injected-clock failover);
+- when every replica sheds, the caller sees ONE consolidated
+  ``ServerOverloaded`` whose ``retry_after`` is the minimum across
+  replicas (satellite: consolidated shed);
+- per-tenant weighted fair admission throttles the hot tenant
+  (retryable ``TenantThrottled``) while others keep admitting;
+- ``/healthz`` splits into liveness and readiness; a draining server is
+  live but not ready (satellite: probe split);
+- ``Fleet.rollout`` shifts, drains, swaps, warms, and restores one
+  replica at a time — zero failed requests under concurrent fire, no
+  stale version served afterwards;
+- the fleet chaos scenario is a pure function of its seed: two seed-0
+  runs produce byte-identical schedules (tier-1 smoke).
+"""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.observability import events, metrics
+from mmlspark_tpu.serve import (
+    Fleet, HttpReplica, ReplicaUnavailable, RequestExpired, Router,
+    Server, ServerOverloaded, TenantThrottled, WeightedFairAdmission,
+)
+from mmlspark_tpu.serve.router import parse_tenant_weights
+from mmlspark_tpu.utils import config
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics.get_registry().reset()
+    yield
+    metrics.get_registry().reset()
+
+
+def make_model(dim=8, classes=3, seed=0):
+    m = JaxModel(inputCol="x", outputCol="y", miniBatchSize=8)
+    m.set_model("mlp_tabular", input_dim=dim, hidden=[16],
+                num_classes=classes, seed=seed)
+    return m
+
+
+def _ticker(start=0.0):
+    state = {"now": float(start)}
+
+    def clock():
+        return state["now"]
+    clock.advance = lambda dt: state.__setitem__("now", state["now"] + dt)
+    return clock
+
+
+class FakeReplica:
+    """Scripted Replica-protocol backend: records every call, raises
+    whatever the test queued in ``fail`` (popped per call), optionally
+    runs ``on_call`` first (e.g. to advance an injected clock)."""
+
+    def __init__(self, name, fail=None, capacity_rows=8):
+        self.name = name
+        self.capacity_rows = capacity_rows
+        self.calls = []              # (model, deadline_ms, trace_id)
+        self.fail = list(fail or [])
+        self.on_call = None
+        self._health = {"live": True, "ready": True, "state": "ready"}
+
+    def submit(self, model, x, deadline_ms=None, trace_id=""):
+        self.calls.append((model, deadline_ms, trace_id))
+        if self.on_call is not None:
+            self.on_call()
+        if self.fail:
+            raise self.fail.pop(0)
+        return np.asarray(x, np.float32) * 2
+
+    def health(self):
+        return dict(self._health)
+
+    def models(self):
+        return ["m"]
+
+
+def _router(*replicas, **kw):
+    kw.setdefault("failover_delay_s", 0.0)
+    kw.setdefault("sleep", lambda s: None)
+    return Router(list(replicas), **kw)
+
+
+X1 = np.ones((1, 4), np.float32)
+
+
+# -- weighted round-robin ----------------------------------------------------
+
+def test_smooth_wrr_is_deterministic_and_even():
+    reps = [FakeReplica(f"r{i}") for i in range(3)]
+    r = _router(*reps)
+    r.route_log = log = []
+    for _ in range(6):
+        np.testing.assert_array_equal(r.submit("m", X1), X1 * 2)
+    # equal weights: the smooth-WRR walk is a fixed cycle (name-max
+    # tiebreak), so same call sequence -> same schedule, exactly
+    assert log == ["r2", "r1", "r0"] * 2
+    assert all(len(rep.calls) == 2 for rep in reps)
+
+
+def test_set_weight_shifts_traffic_and_validates():
+    r0, r1 = FakeReplica("r0"), FakeReplica("r1")
+    r = _router(r0, r1)
+    r.set_weight("r0", 2.0)
+    for _ in range(6):
+        r.submit("m", X1)
+    assert (len(r0.calls), len(r1.calls)) == (4, 2)
+    # weight 0 = out of rotation (the rollout shift lever)
+    r.set_weight("r1", 0.0)
+    for _ in range(2):
+        r.submit("m", X1)
+    assert len(r1.calls) == 2 and len(r0.calls) == 6
+    with pytest.raises(ValueError):
+        r.set_weight("r0", -1.0)
+    with pytest.raises(ValueError):
+        Router([])
+
+
+# -- failover (injected clock) -----------------------------------------------
+
+def test_failover_preserves_trace_id_and_remaining_deadline():
+    clock = _ticker(100.0)
+    dying = FakeReplica("rz", fail=[ReplicaUnavailable("boom")])
+    dying.on_call = lambda: clock.advance(0.02)   # 20ms die mid-request
+    healthy = FakeReplica("ra")
+    r = _router(dying, healthy, failover_attempts=2, clock=clock)
+    out = r.submit("m", X1, deadline_ms=50.0)
+    np.testing.assert_array_equal(out, X1 * 2)
+    # rz (name-max) was offered first, died; EXACTLY one failover onto ra
+    assert len(dying.calls) == 1 and len(healthy.calls) == 1
+    assert r.stats()["failovers"] == 1
+    # same trace the whole chain; the retry gets the REMAINING budget
+    tid_a, tid_b = dying.calls[0][2], healthy.calls[0][2]
+    assert tid_a and tid_a == tid_b
+    assert dying.calls[0][1] == pytest.approx(50.0)
+    assert healthy.calls[0][1] == pytest.approx(30.0)
+    # the dead replica is out of rotation until a probe revives it
+    assert r.stats()["replicas"]["rz"]["state"] == "dead"
+
+
+def test_failover_still_enforces_the_deadline():
+    clock = _ticker(100.0)
+    dying = FakeReplica("rz", fail=[ReplicaUnavailable("boom")])
+    dying.on_call = lambda: clock.advance(0.02)   # eats the whole budget
+    healthy = FakeReplica("ra")
+    r = _router(dying, healthy, failover_attempts=2, clock=clock)
+    with pytest.raises(RequestExpired):
+        r.submit("m", X1, deadline_ms=10.0)
+    assert healthy.calls == []    # never scored an expired request
+
+
+def test_failover_exhausted_is_retryable_unavailable():
+    bad = [FakeReplica(n, fail=[ReplicaUnavailable("x")] * 3)
+           for n in ("ra", "rb")]
+    r = _router(*bad, failover_attempts=2)
+    with pytest.raises(ReplicaUnavailable) as ei:
+        r.submit("m", X1)
+    assert ei.value.retryable
+    assert "ra" in str(ei.value) and "rb" in str(ei.value)
+
+
+def test_client_errors_do_not_failover():
+    first = FakeReplica("rz", fail=[KeyError("no such model")])
+    other = FakeReplica("ra")
+    r = _router(first, other)
+    with pytest.raises(KeyError):
+        r.submit("nope", X1)
+    assert other.calls == []           # same error everywhere: don't retry
+    assert r.stats()["failovers"] == 0
+    # and the answering replica fed its breaker a SUCCESS, not a failure
+    assert r.stats()["replicas"]["rz"]["breaker"] == "closed"
+
+
+# -- consolidated shed (satellite 1) -----------------------------------------
+
+def test_all_shed_consolidates_to_min_retry_after():
+    a = FakeReplica("ra", fail=[ServerOverloaded("full", retry_after=2.5)])
+    b = FakeReplica("rb", fail=[ServerOverloaded("full", retry_after=0.5)])
+    r = _router(a, b)
+    with pytest.raises(ServerOverloaded) as ei:
+        r.submit("m", X1)
+    # ONE consolidated overload: min ask across replicas, retryable,
+    # and NOT charged to the failover budget
+    assert ei.value.retry_after == 0.5
+    assert ei.value.retryable
+    assert not isinstance(ei.value, TenantThrottled)
+    s = r.stats()
+    assert s["all_shed"] == 1 and s["failovers"] == 0
+    # a shed is an ANSWER: breakers stay closed
+    assert all(v["breaker"] == "closed" for v in s["replicas"].values())
+
+
+def test_mixed_shed_and_death_still_reports_overload():
+    shedding = FakeReplica("ra",
+                           fail=[ServerOverloaded("full", retry_after=1.0)])
+    dying = FakeReplica("rb", fail=[ReplicaUnavailable("gone")] * 3)
+    r = _router(shedding, dying, failover_attempts=2)
+    with pytest.raises(ServerOverloaded) as ei:
+        r.submit("m", X1)
+    assert ei.value.retry_after == 1.0
+
+
+# -- per-tenant fairness -----------------------------------------------------
+
+def test_parse_tenant_weights():
+    assert parse_tenant_weights("gold=3, free=1") == \
+        {"gold": 3.0, "free": 1.0}
+    assert parse_tenant_weights("") == {}
+    with pytest.raises(ValueError):
+        parse_tenant_weights("gold")
+    with pytest.raises(ValueError):
+        parse_tenant_weights("gold=0")
+
+
+def test_weighted_fair_admission_quota_shrinks_under_contention():
+    fa = WeightedFairAdmission(8, weights={"gold": 3.0, "free": 1.0})
+    # idle fleet: the only active tenant may use ALL capacity
+    fa.admit("free", 8)
+    # contention: gold's share is 3/4 of 8 = 6; free is now over ITS
+    # shrunken share (2), so free sheds while gold keeps admitting
+    fa.admit("gold", 1)
+    with pytest.raises(TenantThrottled) as ei:
+        fa.admit("free", 1)
+    assert ei.value.tenant == "free"
+    assert isinstance(ei.value, ServerOverloaded) and ei.value.retryable
+    fa.admit("gold", 5)
+    fa.release("free", 8)
+    st = fa.stats()
+    assert st["gold"]["inflight"] == 6 and st["gold"]["weight"] == 3.0
+    assert "vtime_lead" in st["free"]
+
+
+def test_router_throttles_hot_tenant_but_serves_others():
+    rep = FakeReplica("r0", capacity_rows=4)
+    r = _router(rep, tenant_weights={"hog": 1.0, "other": 1.0})
+    r.fairness.admit("hog", 4)        # hog saturates its share
+    try:
+        with pytest.raises(TenantThrottled):
+            r.submit("m", X1, tenant="hog")
+        np.testing.assert_array_equal(
+            r.submit("m", X1, tenant="other"), X1 * 2)
+    finally:
+        r.fairness.release("hog", 4)
+    assert r.stats()["tenants"]["hog"]["inflight"] == 0
+
+
+# -- health probing + breaker recovery ---------------------------------------
+
+def test_probe_rotates_draining_out_and_closes_breaker_after_reset():
+    clock = _ticker()
+    r0, r1 = FakeReplica("r0"), FakeReplica("r1")
+    r1._health = {"live": True, "ready": False, "state": "draining"}
+    r = _router(r0, r1, breaker_failures=2, breaker_reset_s=5.0,
+                clock=clock)
+    assert r.probe() == {"r0": "ready", "r1": "draining"}
+    r.probe()       # second not-ready round: r1's breaker hits threshold
+    for _ in range(4):                  # draining replica gets NO traffic
+        r.submit("m", X1)
+    assert len(r1.calls) == 0 and len(r0.calls) == 4
+    # fleet health: live while any replica is live, ready while any ready
+    h = r.health()
+    assert h["live"] and h["ready"] and h["replicas"]["r1"] == "draining"
+
+    # r1 comes back, but its breaker tripped while it was away (the
+    # probe itself counted failures): a ready probe answer walks the
+    # breaker through half-open -> closed once the reset timeout passes
+    r1._health = {"live": True, "ready": True, "state": "ready"}
+    h1 = r._handles["r1"]
+    assert h1.breaker.state == "open"   # 2 probe failures >= threshold
+    r.probe()                           # too early: reset timeout not up
+    assert h1.breaker.state == "open"
+    clock.advance(5.0)
+    r.probe()
+    assert h1.breaker.state == "closed"
+    r.submit("m", X1)
+    assert len(r1.calls) == 1           # back in rotation
+
+
+# -- router surface ----------------------------------------------------------
+
+def test_submit_many_chunks_and_async_shim():
+    rep = FakeReplica("r0")
+    r = _router(rep)
+    config.set("serving.max_batch", 2)
+    try:
+        out = r.submit_many("m", np.ones((5, 4), np.float32))
+    finally:
+        config.unset("serving.max_batch")
+    assert out.shape == (5, 4)
+    assert [c[0] for c in rep.calls] == ["m", "m", "m"]
+    fut = r.submit_async("m", X1, trace_id="t-42")
+    np.testing.assert_array_equal(fut.result(0), X1 * 2)
+    assert fut.trace_id == "t-42" and rep.calls[-1][2] == "t-42"
+    assert r.registry.names() == ["m"]
+
+
+# -- liveness/readiness split (satellite 2) ----------------------------------
+
+def test_healthz_splits_liveness_from_readiness(tmp_path):
+    import urllib.error
+    import urllib.request
+    from mmlspark_tpu.serve.http import serve_http
+
+    srv = Server({"mlp": make_model()}, start=False)
+    httpd, addr = serve_http(srv, port=0)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+
+    def get(path):
+        with urllib.request.urlopen(f"http://{addr}{path}",
+                                    timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+
+    try:
+        code, body = get("/healthz")
+        assert code == 200 and body["status"] == "ok"
+        assert body["live"] and body["ready"] and body["state"] == "ready"
+        assert get("/livez")[0] == 200 and get("/readyz")[0] == 200
+
+        # draining: still LIVE (in-flight work finishes) but NOT ready —
+        # the router/load-balancer rotates it out before it dies
+        srv._draining = True
+        assert srv.health() == {"live": True, "ready": False,
+                                "state": "draining"}
+        assert get("/livez")[0] == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/readyz")
+        assert ei.value.code == 503
+
+        srv._draining = False
+        srv.close(drain=False)          # closed: neither live nor ready
+        for path in ("/livez", "/readyz"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                get(path)
+            assert ei.value.code == 503
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        srv.close(drain=False)
+
+
+def test_http_replica_roundtrip_and_error_mapping():
+    from mmlspark_tpu.serve.http import serve_http
+
+    m = make_model()
+    with Server({"mlp": m}, max_batch=4, max_wait_ms=1.0) as srv:
+        direct = srv.submit("mlp", np.zeros((1, 8), np.float32),
+                            timeout=30)
+        httpd, addr = serve_http(srv, port=0)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            rep = HttpReplica(addr, name="remote")
+            np.testing.assert_array_equal(
+                rep.submit("mlp", [[0.0] * 8], trace_id="t-1"), direct)
+            assert rep.health() == {"live": True, "ready": True,
+                                    "state": "ready"}
+            assert rep.models() == ["mlp"]
+            with pytest.raises(ValueError):      # 400: client error
+                rep.submit("nope", [[0.0] * 8])
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+    # a dead endpoint is transport-unavailable, i.e. failover fodder
+    dead = HttpReplica("127.0.0.1:9", name="dead", timeout_s=0.5)
+    with pytest.raises(ReplicaUnavailable):
+        dead.submit("mlp", [[0.0] * 8])
+    assert dead.health() == {"live": False, "ready": False,
+                             "state": "dead"}
+
+
+def test_http_replica_maps_503_to_overload():
+    from mmlspark_tpu.serve.http import serve_http
+
+    srv = Server({"mlp": make_model()}, queue_depth=1, start=False)
+    srv.submit_async("mlp", np.zeros(8, np.float32))
+    httpd, addr = serve_http(srv, port=0)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        rep = HttpReplica(addr)
+        with pytest.raises(ServerOverloaded) as ei:
+            rep.submit("mlp", [[0.0] * 8])
+        assert not isinstance(ei.value, ReplicaUnavailable)
+        assert ei.value.retry_after is not None
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        srv.close(drain=False)
+
+
+# -- fleet end to end --------------------------------------------------------
+
+def test_fleet_scores_bit_identical_and_survives_a_kill():
+    m = make_model()
+    X = [np.random.default_rng(i).normal(size=(2, 8)).astype(np.float32)
+         for i in range(9)]
+    with Server({"mlp": m}, max_batch=4) as ref:
+        want = [ref.submit("mlp", x, timeout=30) for x in X]
+    with Fleet({"mlp": m}, replicas=3,
+               server_kwargs={"max_batch": 4}) as fleet:
+        got = [fleet.submit("mlp", x) for x in X[:3]]
+        fleet.kill(0)                    # no drain, router not told
+        got += [fleet.submit("mlp", x) for x in X[3:]]
+        stats = fleet.stats()
+        assert fleet.router.probe()["r0"] == "dead"
+        h = fleet.health()
+    # micro-batching across 3 replicas + a mid-stream kill: numerics
+    # identical to the single server, row for row
+    assert all(np.array_equal(g, w) for g, w in zip(got, want))
+    assert stats["failovers"] >= 1       # the kill was DISCOVERED
+    assert stats["servers"]["r1"]["completed"] > 0
+    assert h["live"] and h["ready"] and h["replicas"]["r0"] == "dead"
+
+
+def test_rollout_is_zero_downtime_and_leaves_no_stale_version():
+    m1, m2 = make_model(seed=0), make_model(seed=1)
+    x = np.zeros((1, 8), np.float32)
+    with Server({"mlp": m2}, max_batch=4) as ref:
+        want_v2 = ref.submit("mlp", x, timeout=30)
+
+    fleet = Fleet({"mlp": m1}, replicas=3, server_kwargs={"max_batch": 4})
+    errs, stop = [], threading.Event()
+
+    def fire():
+        while not stop.is_set():
+            try:
+                fleet.submit("mlp", x)
+            except Exception as e:       # any client-visible failure = red
+                errs.append(e)
+                return
+
+    try:
+        fleet.kill(1)                    # rollout must skip the dead one
+        t = threading.Thread(target=fire, daemon=True)
+        t.start()
+        report = fleet.rollout("mlp", m2, "v2", warm_x=x)
+        stop.set()
+        t.join(timeout=10)
+        assert errs == []                # zero failed requests under fire
+        assert [r["status"] for r in report["replicas"]] == \
+            ["updated", "skipped_dead", "updated"]
+        assert report["versions"] == {"r0": {"mlp": "v2"},
+                                      "r2": {"mlp": "v2"}}
+        # no stale model: every post-rollout score is v2, bit-identical
+        for _ in range(4):
+            np.testing.assert_array_equal(fleet.submit("mlp", x), want_v2)
+    finally:
+        stop.set()
+        fleet.close()
+
+
+def test_rollout_canary_aborts_and_restores_rotation():
+    m1 = make_model()
+    x = np.zeros((1, 8), np.float32)
+    with Fleet({"mlp": m1}, replicas=2,
+               server_kwargs={"max_batch": 4}) as fleet:
+        with pytest.raises(Exception):
+            fleet.rollout("mlp", object(), "v2", warm_x=x)
+        # canary semantics: the fleet keeps serving — the canary is back
+        # in rotation and the OTHER replica never left the old version
+        assert fleet.router._handles["r0"].weight == 1.0
+        assert fleet.servers[1].registry.versions() == {"mlp": "v1"}
+        fleet.submit("mlp", x)
+
+
+def test_report_renders_fleet_section(tmp_path, capsys):
+    path = tmp_path / "events.jsonl"
+    config.set("observability.events_path", str(path))
+    try:
+        x = np.zeros((1, 8), np.float32)
+        with Fleet({"mlp": make_model(seed=0)}, replicas=2,
+                   server_kwargs={"max_batch": 4}) as fleet:
+            fleet.submit("mlp", x)
+            fleet.kill(0)
+            for _ in range(3):
+                fleet.submit("mlp", x)   # forces a failover event
+            fleet.rollout("mlp", make_model(seed=1), "v2", warm_x=x)
+    finally:
+        events.close()
+        config.unset("observability.events_path")
+
+    from mmlspark_tpu.cli import main
+    assert main(["report", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "fleet:" in out
+    assert "failovers: 1" in out
+    assert "replicas killed: r0" in out
+    assert "rollout mlp -> v2: 1 replica(s) shifted, 1 warmed, done" in out
+
+
+# -- chaos (tier-1 smoke: satellite 5) ---------------------------------------
+
+def test_chaos_fleet_scenario_is_deterministic(tmp_path):
+    from mmlspark_tpu.reliability import chaos
+
+    v1 = chaos.run_fleet_scenario(0, str(tmp_path / "a"))
+    metrics.get_registry().reset()
+    v2 = chaos.run_fleet_scenario(0, str(tmp_path / "b"))
+    for v in (v1, v2):
+        assert v["passed"], v["invariants"]
+        assert v["invariants"]["zero_failed_requests"]
+        assert v["invariants"]["scores_bit_identical"]
+        assert v["invariants"]["failover_observed"]
+    # the whole schedule — kill point, victim, per-request serving
+    # replica, failover count — is a pure function of the seed
+    assert v1["schedule"] == v2["schedule"]
+    on_disk = json.loads(
+        (tmp_path / "a" / chaos.VERDICT_FILE).read_text())
+    assert on_disk["passed"] is True
+
+
+def test_cli_chaos_fleet_flag(tmp_path, capsys):
+    from mmlspark_tpu.cli import main
+
+    out = tmp_path / "fleet"
+    assert main(["chaos", "--scenario", "fleet", "--seed", "0",
+                 "--requests", "16", "--out", str(out)]) == 0
+    verdict = json.loads((out / "chaos_verdict.json").read_text())
+    assert verdict["scenario"] == "fleet" and verdict["passed"] is True

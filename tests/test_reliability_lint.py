@@ -295,3 +295,48 @@ def test_syntax_error_is_reported_not_crashing(tmp_path):
     bad.write_text("def broken(:\n")
     probs = lint.check_file(bad)
     assert len(probs) == 1 and "syntax error" in probs[0]
+
+# -- rule 8: direct replica calls in serve/ ----------------------------------
+
+def test_flags_direct_replica_call_in_serve():
+    src = textwrap.dedent("""
+        def warm(replica, x):
+            return replica.submit("m", x)
+
+        class H:
+            def go(self, x):
+                return self.replica.submit_many("m", x)
+    """)
+    probs = lint.check_source(
+        src, filename="mmlspark_tpu/serve/fleet.py")
+    assert len(probs) == 2
+    assert all("direct replica call" in p for p in probs)
+    assert "allow-direct-replica" in probs[0]   # the escape hatch is named
+    assert "fleet.py:3" in probs[0]
+
+
+def test_replica_rule_scoped_to_serve_and_home_exempt():
+    src = textwrap.dedent("""
+        def warm(replica, x):
+            return replica.submit("m", x)
+    """)
+    # the router IS the wrapper layer: its raw calls are the point
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/serve/router.py") == []
+    # outside serve/ the rule does not apply (chaos, tests, benches
+    # drive replicas deliberately)
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/reliability/chaos.py") == []
+
+
+def test_replica_rule_marker_and_non_replica_receivers():
+    assert lint.check_source(textwrap.dedent("""
+        def warm(replica, x):
+            return replica.submit("m", x)  # lint: allow-direct-replica
+
+        def fine(server, x):
+            return server.submit("m", x)
+
+        def also_fine(replica):
+            return replica.health()
+    """), filename="mmlspark_tpu/serve/fleet.py") == []
