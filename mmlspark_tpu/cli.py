@@ -165,6 +165,10 @@ def cmd_run(args, passthrough: List[str]) -> int:
                     f"--platform {args.platform}: backend initialized as "
                     f"{backend!r} (JAX was touched before the launcher "
                     "could pin the platform)")
+        # persistent compile cache: wire jax_compilation_cache_dir before
+        # the user script compiles anything (no-op when the key is unset)
+        from mmlspark_tpu import compile_cache
+        compile_cache.enable_from_config()
         saved_argv, saved_path = sys.argv, list(sys.path)
         sys.argv = [script] + passthrough
         sys.path.insert(0, os.path.dirname(os.path.abspath(script)))
@@ -310,12 +314,17 @@ def cmd_serve(args, passthrough) -> int:
     drain gracefully — admission stops (503 + Retry-After), in-flight
     batches finish, then the server closes (docs/RELIABILITY.md)."""
     import threading
+    from mmlspark_tpu import compile_cache
     from mmlspark_tpu.models.jax_model import JaxModel
     from mmlspark_tpu.reliability import preemption
     from mmlspark_tpu.reliability.watchdog import Watchdog
     from mmlspark_tpu.serve.http import serve_http
     from mmlspark_tpu.serve.server import Server
     from mmlspark_tpu.utils import config as mmlconfig
+    # second startup against a warm runtime.compile_cache_dir skips every
+    # bucket compile: jax's cache for jit paths + the AOT program cache
+    # consulted by ModelEntry._compile (docs/PERFORMANCE.md)
+    compile_cache.enable_from_config()
     if not args.model:
         raise SystemExit(
             "serve: at least one --model NAME=ARCH[:JSON-kwargs] required "

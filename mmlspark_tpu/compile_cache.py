@@ -1,0 +1,292 @@
+"""Persistent compilation cache: recompilation is the other overhead floor.
+
+BENCH_r05's low-MFU lanes are dispatch-bound (killed by the sync-free
+stepping in :mod:`~mmlspark_tpu.parallel.trainer`), but every process
+RESTART and every :meth:`Fleet.rollout` replica warm pays a second tax —
+recompiling programs whose HLO has not changed. This module removes it in
+two layers, both keyed off the ``runtime.compile_cache_dir`` config key
+("" = off, nothing touches disk):
+
+1. :func:`enable_from_config` wires jax's own persistent compilation cache
+   (``jax_compilation_cache_dir``) so EVERY jit path — trainer steps, eval
+   programs, transform closures — reuses XLA output across processes.
+   Idempotent; call it once at process entry (the CLI does).
+
+2. :func:`load_or_compile` — an on-disk AOT *program* cache for the serve
+   bucket executables behind :meth:`ModelEntry._compile`. jax's cache only
+   skips XLA backend work; the serve path AOT-compiles concrete
+   executables, and ``jax.experimental.serialize_executable`` lets the
+   whole loaded program skip lowering too. Entries are keyed on
+   (model name+version, padded bucket shape, dtype) in the file NAME and
+   carry the (jax version, jaxlib version, device fingerprint) environment
+   in the file HEADER, so a stale toolchain is *detected* (bypass event +
+   fresh compile overwrites) rather than silently misloaded. Writes go
+   through the reliability layer's tmp-file + ``os.replace`` atomic
+   pattern — a concurrent writer loses the race harmlessly and readers
+   never observe a torn file; payloads are sha256-verified on load and
+   corrupt entries are quarantined aside (``.corrupt``) to a fresh
+   compile.
+
+Every outcome is counted (``compile_cache.hits/misses/bypasses/stale/
+quarantined/stores`` counters) and evented (``compile_cache.*``), feeding
+the ``mmlspark-tpu report`` compile-cache section. This module is also the
+sanctioned compile seam for serve code: lint Rule 9 flags any
+``lower().compile()`` / ``jax.jit`` call site under ``serve/`` that does
+not route here (``# lint: allow-compile`` opts out deliberately).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import threading
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+from mmlspark_tpu.observability import events, metrics
+from mmlspark_tpu.utils import config as mmlconfig
+from mmlspark_tpu.utils.logging import get_logger
+
+logger = get_logger("compile_cache")
+
+_FORMAT_VERSION = 1
+_SUFFIX = ".xprog"
+
+_lock = threading.Lock()
+_enabled_dir: Optional[str] = None  # enable_from_config idempotence
+
+
+class CacheResult(NamedTuple):
+    """What :func:`load_or_compile` did: the executable plus provenance
+    (``source`` in {hit, miss, stale, corrupt, bypass}) so callers count
+    real compiles separately from cache loads."""
+    program: Callable
+    source: str
+
+    @property
+    def hit(self) -> bool:
+        return self.source == "hit"
+
+
+def cache_dir() -> str:
+    """The configured cache root ("" = caching off)."""
+    return str(mmlconfig.get("runtime.compile_cache_dir") or "")
+
+
+def enable_from_config() -> Optional[str]:
+    """Wire ``jax_compilation_cache_dir`` from ``runtime.compile_cache_dir``
+    for all jit paths. Returns the directory when enabled, None when the
+    key is unset. Idempotent per directory; safe to call before or after
+    jax initializes its backends."""
+    global _enabled_dir
+    root = cache_dir()
+    if not root:
+        return None
+    with _lock:
+        if _enabled_dir == root:
+            return root
+        os.makedirs(root, exist_ok=True)
+        import jax
+        jax.config.update("jax_compilation_cache_dir", root)
+        # cache tiny programs too: the serve buckets and bench lanes this
+        # exists for compile in well under the 1s default threshold
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _enabled_dir = root
+    if events.recording_enabled():
+        events.emit("compile_cache", "enabled", dir=root)
+    logger.info("persistent compilation cache at %s", root)
+    return root
+
+
+def device_fingerprint() -> str:
+    """Stable identity of the toolchain + attached devices: a serialized
+    executable is only loadable onto the platform/topology it was built
+    for, and a jax/jaxlib bump invalidates the wire format."""
+    import jax
+    try:
+        import jaxlib.version
+        jaxlib_v = jaxlib.version.__version__
+    except ImportError:
+        jaxlib_v = "?"
+    devs = jax.devices()
+    return "|".join([
+        f"jax={jax.__version__}",
+        f"jaxlib={jaxlib_v}",
+        f"platform={devs[0].platform if devs else '?'}",
+        f"kind={getattr(devs[0], 'device_kind', '?') if devs else '?'}",
+        f"n={len(devs)}",
+    ])
+
+
+def entry_key(model: str, version: str, bucket: int,
+              row_shape: Tuple[int, ...], dtype: str) -> str:
+    """Filename stem for one program: the model+shape identity. The
+    environment (jax/device fingerprint) lives in the header, not the
+    name, so a toolchain bump is a *detected* stale entry, not a silent
+    cache miss that leaves garbage behind."""
+    ident = "\x00".join([model, version, str(int(bucket)),
+                         ",".join(str(int(d)) for d in row_shape),
+                         str(dtype)])
+    return hashlib.sha256(ident.encode("utf-8")).hexdigest()[:40]
+
+
+def _aot_dir(root: str) -> str:
+    # separate the AOT program entries from jax's own cache files
+    return os.path.join(root, "aot")
+
+
+def _counter(name: str):
+    return metrics.counter(f"compile_cache.{name}")
+
+
+def _event(name: str, **fields: Any) -> None:
+    if events.recording_enabled():
+        events.emit("compile_cache", name, **fields)
+
+
+def _quarantine(path: str) -> None:
+    """Move a bad entry aside (atomic; never deletes evidence) so the next
+    writer starts clean and the corruption is inspectable."""
+    try:
+        os.replace(path, path + ".corrupt")
+    except OSError:
+        pass  # raced with another quarantining process: already gone
+    _counter("quarantined").inc()
+
+
+def _load_entry(path: str, fingerprint: str) -> CacheResult | None:
+    """Deserialize one on-disk program; None means the caller compiles
+    fresh (the entry was absent, stale, or quarantined-corrupt)."""
+    try:
+        with open(path, "rb") as f:
+            header_line = f.readline()
+            body = f.read()
+        header = json.loads(header_line.decode("utf-8"))
+        if header.get("v") != _FORMAT_VERSION:
+            raise ValueError(f"format v{header.get('v')}")
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError, UnicodeDecodeError) as e:
+        logger.warning("compile cache entry %s unreadable (%s); "
+                       "quarantined", path, e)
+        _event("quarantine", path=path, reason=f"header: {e}")
+        _quarantine(path)
+        return None
+    if header.get("env") != fingerprint:
+        # a different toolchain/topology wrote this: bypass it and let the
+        # fresh compile overwrite the entry for the current environment
+        _counter("stale").inc()
+        _event("stale", path=path, entry_env=header.get("env"),
+               env=fingerprint)
+        return CacheResult(None, "stale")  # type: ignore[arg-type]
+    digest = hashlib.sha256(body).hexdigest()
+    if digest != header.get("sha256"):
+        logger.warning("compile cache entry %s failed sha256 verification; "
+                       "quarantined", path)
+        _event("quarantine", path=path, reason="sha256 mismatch")
+        _quarantine(path)
+        return None
+    try:
+        from jax.experimental import serialize_executable
+        payload, in_tree, out_tree = pickle.loads(body)
+        program = serialize_executable.deserialize_and_load(
+            payload, in_tree, out_tree)
+    except Exception as e:  # deserialization is version-fragile by nature
+        logger.warning("compile cache entry %s failed to deserialize "
+                       "(%s: %s); quarantined", path, type(e).__name__, e)
+        _event("quarantine", path=path,
+               reason=f"{type(e).__name__}: {e}")
+        _quarantine(path)
+        return None
+    return CacheResult(program, "hit")
+
+
+def _store_entry(path: str, program, meta: Dict[str, Any],
+                 fingerprint: str) -> bool:
+    """Serialize + atomically publish one compiled program. False when the
+    executable does not support serialization (counted as a bypass — the
+    compile still happened and serving proceeds uncached)."""
+    try:
+        from jax.experimental import serialize_executable
+        body = pickle.dumps(serialize_executable.serialize(program))
+    except Exception as e:
+        _counter("bypasses").inc()
+        _event("bypass", reason=f"serialize: {type(e).__name__}: {e}",
+               **meta)
+        return False
+    header = dict(meta, v=_FORMAT_VERSION, env=fingerprint,
+                  sha256=hashlib.sha256(body).hexdigest(), size=len(body))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(header, sort_keys=True).encode("utf-8"))
+            f.write(b"\n")
+            f.write(body)
+        os.replace(tmp, path)  # atomic: concurrent writers last-win whole
+    except OSError as e:
+        logger.warning("compile cache store failed for %s (%s)", path, e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+    _counter("stores").inc()
+    _event("store", path=path, bytes=len(body), **meta)
+    return True
+
+
+def load_or_compile(model: str, version: str, bucket: int,
+                    row_shape: Tuple[int, ...], dtype: Any,
+                    jitted, params) -> CacheResult:
+    """The serve-side compile seam: return the AOT executable for one
+    padded bucket shape, loading it from ``runtime.compile_cache_dir``
+    when a verified entry exists and compiling (then storing) otherwise.
+
+    ``jitted`` is the model's raw jitted apply (``apply._jitted``) and
+    ``params`` its device-resident tree — the compile itself happens HERE
+    so serve/ modules never spell ``lower().compile()`` (lint Rule 9).
+    The returned program is called as ``program(params, x)``.
+    """
+    import jax
+    import numpy as np
+    dtype_name = np.dtype(dtype).name
+    spec = jax.ShapeDtypeStruct((int(bucket),) + tuple(row_shape),
+                                np.dtype(dtype))
+    meta = {"model": model, "version": version, "bucket": int(bucket),
+            "row_shape": list(int(d) for d in row_shape),
+            "dtype": dtype_name}
+
+    def fresh() -> Callable:
+        return jitted.lower(params, spec).compile()
+
+    root = cache_dir()
+    if not root:
+        _counter("bypasses").inc()
+        _event("bypass", reason="runtime.compile_cache_dir unset", **meta)
+        return CacheResult(fresh(), "bypass")
+    path = os.path.join(
+        _aot_dir(root),
+        entry_key(model, version, bucket, tuple(row_shape), dtype_name)
+        + _SUFFIX)
+    fingerprint = device_fingerprint()
+    loaded = _load_entry(path, fingerprint)
+    if loaded is not None and loaded.source == "hit":
+        _counter("hits").inc()
+        _event("hit", path=path, **meta)
+        return loaded
+    source = loaded.source if loaded is not None else "miss"
+    if source == "miss":
+        _counter("misses").inc()
+        _event("miss", path=path, **meta)
+    program = fresh()
+    _store_entry(path, program, meta, fingerprint)
+    return CacheResult(program, source)
+
+
+def stats() -> Dict[str, int]:
+    """Hit/miss/bypass/stale/quarantine/store counter snapshot (the report
+    section and tests read this)."""
+    return {name: int(_counter(name).value)
+            for name in ("hits", "misses", "bypasses", "stale",
+                         "quarantined", "stores")}

@@ -99,58 +99,73 @@ def confusion_matrix(y: np.ndarray, pred: np.ndarray, k: int) -> np.ndarray:
 
 
 # -- device-path evaluators --------------------------------------------------
-# Above ``evaluate.device_rows`` rows, metrics run as jitted XLA programs
-# instead of driver numpy: the scored column stays columnar and the driver
-# only ever sees the k x k confusion and two scalars — the
-# everything-streams-to-device story applied to evaluation, where the
-# reference funneled the whole scored RDD through driver-side Spark
-# aggregations (``ComputeModelStatistics.scala:86-559``). Below the
-# threshold the numpy path wins on latency (no transfer, no compile).
+# Above ``evaluate.device_rows`` rows, metrics run as ONE fused jitted XLA
+# program instead of driver numpy: the scored column stays columnar, the
+# confusion matrix accumulates on device in a scan over fixed-size row
+# chunks (donated accumulator — no second buffer), the AUC staircase runs
+# in the same program, and the driver sees exactly ONE counted host sync
+# per evaluate call (``evaluate.finalize``) fetching the k x k confusion
+# plus two scalars — the everything-streams-to-device story applied to
+# evaluation, where the reference funneled the whole scored RDD through
+# driver-side Spark aggregations (``ComputeModelStatistics.scala:86-559``).
+# Below the threshold the numpy path wins on latency (no transfer, no
+# compile).
 
-@functools.lru_cache(maxsize=1)
-def _device_confusion_jit():
-    """Module-cached jit (a per-call jax.jit would recompile every
-    transform — FindBestModel evaluates N candidates on one frame)."""
+# rows per scan chunk: fixed so the chunk program shape is stable and the
+# number of distinct compiled shapes grows with log-ish dataset size, not
+# per dataset length
+_EVAL_CHUNK = 4096
+
+
+@functools.lru_cache(maxsize=8)
+def _device_eval_jit(k: int, with_auc: bool):
+    """Module-cached fused evaluator (a per-call jax.jit would recompile
+    every transform — FindBestModel evaluates N candidates on one frame).
+
+    Takes ``(acc, yy, pp, ss, ww)`` where ``acc`` is the DONATED flat
+    confusion accumulator and the rest are ``(chunks, _EVAL_CHUNK)``
+    row-padded columns (``ww`` 1 for real rows, 0 for padding)."""
     import jax
     import jax.numpy as jnp
 
-    @functools.partial(jax.jit, static_argnums=2)
-    def cm(yy, pp, kk):
-        # int32 scatter-add into k*k cells: O(n) memory and exact counts
-        # (a one-hot matmul would be O(n*k) HBM and float32-inexact past
-        # 2^24 per cell)
-        flat = yy.astype(jnp.int32) * kk + pp.astype(jnp.int32)
-        return jnp.zeros((kk * kk,), jnp.int32).at[flat].add(1) \
-            .reshape(kk, kk)
-    return cm
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def fused(acc, yy, pp, ss, ww):
+        # confusion: int32 scatter-add into k*k cells, accumulated across
+        # chunks in a scan carry (exact counts; a one-hot matmul would be
+        # O(n*k) HBM and float32-inexact past 2^24 per cell). Pad rows get
+        # a deliberately out-of-range flat index — XLA scatter DROPS
+        # out-of-bounds updates, so padding never lands a count.
+        def body(cm, chunk):
+            y, p, w = chunk
+            flat = jnp.where(w > 0, y * k + p, k * k)
+            return cm.at[flat].add(1), None
+        # the carry stays flat all the way out: same shape/dtype as the
+        # donated input, so XLA aliases the accumulator in place (the host
+        # wrapper reshapes to k x k after the fetch)
+        cm, _ = jax.lax.scan(body, acc, (yy, pp, ww))
+        if not with_auc:
+            return cm
 
-
-def _device_confusion(y, pred, k: int) -> np.ndarray:
-    import jax
-    import jax.numpy as jnp
-    out = _device_confusion_jit()(jnp.asarray(y, np.int32),
-                                  jnp.asarray(pred, np.int32), int(k))
-    from mmlspark_tpu.observability import syncs
-    return np.asarray(
-        syncs.device_get(out, "evaluate.confusion")).astype(np.int64)
-
-
-@functools.lru_cache(maxsize=1)
-def _device_auc_jit():
-    import jax
-    import jax.numpy as jnp
-
-    @jax.jit
-    def both(yy, ss):
-        n = yy.shape[0]
-        order = jnp.argsort(-ss, stable=True)
-        ys = yy[order].astype(jnp.int32)
-        sss = ss[order]
+        # AUC + areaUnderPR, numerically identical to the numpy
+        # staircase+trapezoid path: sort by descending score, mark
+        # distinct-threshold group ends, and accumulate each kept point's
+        # trapezoid against the PREVIOUS kept point found with an
+        # exclusive cummax over masked indices — no dynamic shapes, no
+        # host round trip per threshold. Pad rows sort last (score -inf)
+        # with weight 0: the cumulative counts never see them and their
+        # lone group end contributes a zero-width trapezoid.
+        s = jnp.where(ww.reshape(-1) > 0, ss.reshape(-1), -jnp.inf)
+        w = ww.reshape(-1)
+        n = s.shape[0]
+        order = jnp.argsort(-s, stable=True)
+        ws = w[order].astype(jnp.int32)
+        ys = yy.reshape(-1)[order].astype(jnp.int32) * ws
+        sss = s[order]
         # integer cumulative counts: exact up to 2^31 rows (float32
         # cumsums stop counting past 2^24 — exactly the large-n regime
         # this path is gated to)
         tps = jnp.cumsum(ys)
-        fps = jnp.cumsum(1 - ys)
+        fps = jnp.cumsum(ws - ys)
         P = jnp.maximum(tps[-1], 1).astype(jnp.float32)
         N = jnp.maximum(fps[-1], 1).astype(jnp.float32)
         mask = jnp.concatenate([sss[:-1] != sss[1:],
@@ -173,25 +188,46 @@ def _device_auc_jit():
         fpr, tpr = fpsf / N, tpsf / P
         recall = tpsf / P
         prec = tpsf / jnp.maximum(tpsf + fpsf, 1.0)
-        return area(fpr, tpr, 0.0), area(recall, prec, 1.0)
-    return both
+        return cm, area(fpr, tpr, 0.0), area(recall, prec, 1.0)
+    return fused
 
 
-def _device_auc_aucpr(y, scores) -> Tuple[float, float]:
-    """ROC-AUC and areaUnderPR as ONE fixed-shape jitted program,
-    numerically identical to the numpy staircase+trapezoid path: sort by
-    descending score, mark distinct-threshold group ends, and accumulate
-    each kept point's trapezoid against the PREVIOUS kept point found
-    with an exclusive cummax over masked indices — no dynamic shapes, no
-    host round trip per threshold."""
-    import jax
+def _device_eval(y, pred, k: int, scores=None
+                 ) -> Tuple[np.ndarray, Optional[Tuple[float, float]]]:
+    """Run the fused device evaluator: confusion matrix always, plus
+    (AUC, areaUnderPR) when binary ``scores`` are given. Exactly one
+    counted host sync (``evaluate.finalize``) fetches every result
+    together at the end; the confusion accumulator is donated to the
+    program, so evaluation allocates no second copy of it."""
     import jax.numpy as jnp
-    a, pr = _device_auc_jit()(jnp.asarray(np.asarray(y, np.int32)),
-                              jnp.asarray(np.asarray(scores, np.float32)))
     from mmlspark_tpu.observability import syncs
-    # one counted sync: (a, pr) fetched together, not two round trips
-    a, pr = syncs.device_get((a, pr), "evaluate.auc")
-    return float(a), float(pr)
+    n = len(y)
+    chunks = max(1, -(-n // _EVAL_CHUNK))
+    total = chunks * _EVAL_CHUNK
+    shape = (chunks, _EVAL_CHUNK)
+    yy = np.zeros((total,), np.int32)
+    yy[:n] = np.asarray(y, np.int64)
+    pp = np.zeros((total,), np.int32)
+    pp[:n] = np.asarray(pred, np.int64)
+    ww = np.zeros((total,), np.int32)
+    ww[:n] = 1
+    ss = np.zeros((total,), np.float32)
+    with_auc = scores is not None
+    if with_auc:
+        ss[:n] = np.asarray(scores, np.float32)
+    fused = _device_eval_jit(int(k), with_auc)
+    acc = jnp.zeros((int(k) * int(k),), jnp.int32)
+    out = fused(acc, jnp.asarray(yy.reshape(shape)),
+                jnp.asarray(pp.reshape(shape)),
+                jnp.asarray(ss.reshape(shape)),
+                jnp.asarray(ww.reshape(shape)))
+    # THE one host sync of the evaluate call: cm (+ both areas) together
+    out = syncs.device_get(out, "evaluate.finalize")
+    if with_auc:
+        cm, a, pr = out
+        return (np.asarray(cm).astype(np.int64).reshape(k, k),
+                (float(a), float(pr)))
+    return np.asarray(out).astype(np.int64).reshape(k, k), None
 
 
 def binary_accuracy_precision_recall(cm: np.ndarray) -> Tuple[float, float, float]:
@@ -307,42 +343,53 @@ class ComputeModelStatistics(Transformer):
         k = int(max(y.max(initial=0), pred.max(initial=0))) + 1
         from mmlspark_tpu.utils import config as mmlconfig
         on_device = len(y) >= int(mmlconfig.get("evaluate.device_rows"))
-        cm = (_device_confusion(y, pred, k) if on_device
-              else confusion_matrix(y, pred, k))
-        self.confusion_matrix = cm
+
+        pos = None
+        if k == 2 and scores is not None:
+            sc = np.asarray(frame.column(scores))
+            pos = sc[:, 1] if sc.ndim == 2 and sc.shape[1] >= 2 else sc.ravel()
 
         metrics: Dict[str, float] = {}
-        if k == 2:
-            acc, prec, rec = binary_accuracy_precision_recall(cm)
-            metrics.update({ACCURACY: acc, PRECISION: prec, RECALL: rec})
-            if scores is not None:
-                sc = np.asarray(frame.column(scores))
-                pos = sc[:, 1] if sc.ndim == 2 and sc.shape[1] >= 2 else sc.ravel()
-                if on_device:
-                    # the full ROC staircase (n points) is not fetched to
-                    # the driver above the threshold; metric scalars come
-                    # from the jitted program — say so, because callers
-                    # that expect the roc_curve artifact get None here
-                    from mmlspark_tpu.utils.logging import get_logger
-                    get_logger("evaluate").info(
-                        "device-path evaluation (%d rows >= "
-                        "evaluate.device_rows): roc_curve artifact not "
-                        "materialized; lower the threshold to retain it",
-                        len(y))
-                    metrics[AUC], metrics[AUC_PR] = _device_auc_aucpr(
-                        y, pos)
-                else:
-                    curve = roc_curve(y, pos.astype(np.float64))
-                    self.roc_curve = curve
-                    metrics[AUC] = auc_from_roc(curve)
-                    metrics[AUC_PR] = auc_from_pr(
-                        pr_curve(y, pos.astype(np.float64)))
+        if on_device:
+            if pos is not None:
+                # the full ROC staircase (n points) is not fetched to the
+                # driver above the threshold; metric scalars come from the
+                # fused jitted program — say so, because callers that
+                # expect the roc_curve artifact get None here
+                from mmlspark_tpu.utils.logging import get_logger
+                get_logger("evaluate").info(
+                    "device-path evaluation (%d rows >= "
+                    "evaluate.device_rows): roc_curve artifact not "
+                    "materialized; lower the threshold to retain it",
+                    len(y))
+            cm, auc_pair = _device_eval(y, pred, k, pos)
+            self.confusion_matrix = cm
+            metrics.update(self._metrics_from_cm(cm))
+            if auc_pair is not None:
+                metrics[AUC], metrics[AUC_PR] = auc_pair
         else:
-            mc = multiclass_metrics(cm)
-            metrics.update(mc)
-            metrics[PRECISION] = mc["micro_averaged_precision"]
-            metrics[RECALL] = mc["micro_averaged_recall"]
+            cm = confusion_matrix(y, pred, k)
+            self.confusion_matrix = cm
+            metrics.update(self._metrics_from_cm(cm))
+            if pos is not None:
+                curve = roc_curve(y, pos.astype(np.float64))
+                self.roc_curve = curve
+                metrics[AUC] = auc_from_roc(curve)
+                metrics[AUC_PR] = auc_from_pr(
+                    pr_curve(y, pos.astype(np.float64)))
         return self._metrics_frame(metrics, CLASSIFICATION_METRICS)
+
+    @staticmethod
+    def _metrics_from_cm(cm: np.ndarray) -> Dict[str, float]:
+        """Confusion-derived metrics, shared by the fused-device and numpy
+        paths (both hand over the same exact integer counts)."""
+        if cm.shape[0] == 2:
+            acc, prec, rec = binary_accuracy_precision_recall(cm)
+            return {ACCURACY: acc, PRECISION: prec, RECALL: rec}
+        mc = multiclass_metrics(cm)
+        mc[PRECISION] = mc["micro_averaged_precision"]
+        mc[RECALL] = mc["micro_averaged_recall"]
+        return mc
 
     def _label_indices(self, frame: Frame, label: str,
                        scored_labels: str) -> np.ndarray:

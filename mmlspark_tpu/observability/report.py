@@ -15,6 +15,10 @@ print where the time went —
   data-state sidecars, flight-recorder dumps;
 - host syncs: ``sync.point`` events by site (the ROADMAP item-4
   "zero host syncs per step" scoreboard — see observability/syncs.py);
+- compile cache: hit/miss/stale/store/bypass/quarantine activity from the
+  ``compile_cache.*`` events the persistent AOT program cache emits
+  (mmlspark_tpu/compile_cache.py), with the hit rate the rollout warm
+  path is supposed to drive up;
 - throughput: the ``train.fit`` / ``train.step`` summaries the trainer and
   MetricLogger emit (steps, rows, examples/sec), plus any bench results;
 - serving: per-request SLO breakdown from the serve subsystem's
@@ -307,6 +311,28 @@ def build_report(path: str, top: int = 10,
             fl["rollouts"] = list(by_target.values())
         report["fleet"] = fl
 
+    # -- compile cache (compile_cache.* events) ----------------------------
+    cc = [e for e in events if e.get("type") == "compile_cache"]
+    if cc:
+        by_name: Dict[str, int] = defaultdict(int)
+        for e in cc:
+            by_name[str(e.get("name", "?"))] += 1
+        sec = {"events": len(cc),
+               "hits": by_name.get("hit", 0),
+               "misses": by_name.get("miss", 0),
+               "stores": by_name.get("store", 0),
+               "stale": by_name.get("stale", 0),
+               "bypasses": by_name.get("bypass", 0),
+               "quarantined": by_name.get("quarantine", 0)}
+        looked = sec["hits"] + sec["misses"] + sec["stale"]
+        sec["hit_rate"] = round(
+            (100.0 * sec["hits"] / looked) if looked else 0.0, 2)
+        quar = [e for e in cc if e.get("name") == "quarantine"]
+        if quar:
+            sec["quarantine_reasons"] = sorted(
+                {str(e.get("reason", "?")) for e in quar})
+        report["compile_cache"] = sec
+
     # -- throughput --------------------------------------------------------
     fits = [e for e in plain if e.get("name") == "train.fit"]
     step_metrics = [e for e in metrics if e.get("name") == "train.step"]
@@ -483,6 +509,19 @@ def render_report(path: str, top: int = 10) -> str:
                 f"  rollout {ro['model']} -> {ro['version']}: "
                 f"{ro['shifted']} replica(s) shifted, "
                 f"{ro['warmed']} warmed, {ro['status']}")
+        out.append("")
+
+    if "compile_cache" in r:
+        cc = r["compile_cache"]
+        out.append("compile cache:")
+        out.append(
+            f"  lookups: {cc['hits']} hit(s), {cc['misses']} miss(es), "
+            f"{cc['stale']} stale ({cc['hit_rate']:.1f}% hit rate); "
+            f"{cc['stores']} store(s), {cc['bypasses']} bypass(es)")
+        if cc.get("quarantined"):
+            reasons = "; ".join(cc.get("quarantine_reasons", ()))
+            out.append(f"  quarantined entries: {cc['quarantined']}"
+                       + (f" [{reasons}]" if reasons else ""))
         out.append("")
 
     if "throughput" in r:

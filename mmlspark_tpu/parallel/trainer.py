@@ -227,8 +227,6 @@ class DistributedTrainer:
     forward, backward, allreduce, optimizer — compiles to one XLA program.
     """
 
-    _THROTTLE = 16  # max un-retired async step programs (see train_step)
-
     def __init__(self, loss_fn: LossFn, optimizer: optax.GradientTransformation,
                  mesh: Optional[Mesh] = None, rules: Optional[Rules] = None,
                  accum_steps: int = 1, seq_axis: Optional[str] = None,
@@ -243,14 +241,23 @@ class DistributedTrainer:
         self.seq_axis = seq_axis
         self.remat = remat
         self._state_shardings = None
-        self._train_step = None
+        # two jitted step variants, keyed by whether the batch buffers are
+        # donated (fit's streaming path donates; direct callers feeding
+        # reused device batches — DeviceEpochCache epochs — must not)
+        self._train_steps: Dict[bool, Any] = {}
         self._eval_step = None
-        # Dispatch-depth throttle (see train_step): ONLY the multi-device
-        # CPU runtime needs it — its collective rendezvous can starve under
-        # hundreds of queued async steps. Real TPU runtimes bound their own
-        # launch queue, and the readiness probe would cost a host round
-        # trip per step on remote chips.
-        self._inflight: list = []
+        # Device-resident metrics ring (ROADMAP item 4, "kill the overhead
+        # floor"): per-step scalars (loss, step counter) accumulate in a
+        # ring CARRIED THROUGH the jitted step instead of a host-side list
+        # of device scalars, so steady-state stepping performs ZERO host
+        # syncs. The ring is fetched ("flushed") once every
+        # ``train.metrics_flush_steps`` steps; on the multi-device CPU
+        # runtime that flush doubles as the dispatch-depth throttle (its
+        # collective rendezvous can starve under hundreds of queued async
+        # steps — real TPU runtimes bound their own launch queue).
+        self._ring: Optional[Dict[str, jax.Array]] = None
+        self._flush_steps: Optional[int] = None  # resolved at first step
+        self._steps_since_flush = 0
         self._throttled = is_cpu_mesh(self.mesh)
         self._flops_per_step: Optional[float] = None  # lazy cost analysis
 
@@ -288,17 +295,40 @@ class DistributedTrainer:
         return self._state_shardings
 
     # -- steps -------------------------------------------------------------
-    def _build_train_step(self):
+    def flush_steps(self) -> int:
+        """Steps between metric-ring flushes (``train.metrics_flush_steps``,
+        resolved once at first use — the ring length is a compile-time
+        constant of the step program)."""
+        if self._flush_steps is None:
+            self._flush_steps = max(
+                1, int(mmlconfig.get("train.metrics_flush_steps")))
+        return self._flush_steps
+
+    def _init_ring(self) -> Dict[str, jax.Array]:
+        """Fresh device-resident metrics ring: a ``flush_steps``-long loss
+        ring plus the step counter of the latest step written. Replicated
+        on purpose — every process flushes identical values under SPMD."""
+        flush = self.flush_steps()
+        repl = NamedSharding(self.mesh, P())
+        with self.mesh:
+            return {
+                "loss": jax.device_put(
+                    np.zeros((flush,), np.float32), repl),
+                "step": jax.device_put(np.zeros((), np.int32), repl),
+            }
+
+    def _build_train_step(self, donate_batch: bool):
         loss_fn = self.loss_fn
         if self.remat:
             loss_fn = jax.checkpoint(loss_fn)
         accum = self.accum_steps
+        flush = self.flush_steps()
 
         def single_grad(params, batch, rng):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
             return loss, grads
 
-        def step(state, batch, rng):
+        def step(state, ring, batch, rng):
             params = state["params"]
             rng = jax.random.fold_in(rng, state["step"])
             if accum > 1:
@@ -327,39 +357,92 @@ class DistributedTrainer:
             new_params = optax.apply_updates(params, updates)
             new_state = {"params": new_params, "opt_state": opt_state,
                          "step": state["step"] + 1}
-            return new_state, {"loss": loss}
+            # metrics ring: the loss lands in slot (step mod flush) ON
+            # device — no per-step host traffic; the host reads the whole
+            # ring once per flush interval
+            new_ring = {"loss": ring["loss"].at[
+                jnp.mod(state["step"], flush)].set(loss),
+                "step": new_state["step"]}
+            return new_state, new_ring, {"loss": loss}
 
         # Batch shardings are NOT pinned here: put_batch commits per-leaf
         # shardings (rank-aware — labels are rank-1, activations rank-N) and
         # jit infers from the committed arrays. Pinning a rank-2 spec on the
-        # whole batch dict would crash on rank-1 leaves.
+        # whole batch dict would crash on rank-1 leaves. Donation extends
+        # the same rank-awareness: state and ring always donate (their
+        # buffers are dead the instant the step returns); the batch donates
+        # only on the streaming path (argnum 2, per-leaf committed
+        # shardings), where each put_batch transfer is single-use — donating
+        # it stops the step from double-buffering its inputs. Reused device
+        # batches (DeviceEpochCache epochs) take the non-donating variant.
+        ring_shardings = {"loss": NamedSharding(self.mesh, P()),
+                          "step": NamedSharding(self.mesh, P())}
         return jax.jit(
             step,
-            out_shardings=(self._state_shardings, None),
-            donate_argnums=(0,))
+            out_shardings=(self._state_shardings, ring_shardings, None),
+            donate_argnums=(0, 1, 2) if donate_batch else (0, 1))
 
-    def train_step(self, state, batch, rng) -> Tuple[Any, Dict[str, jax.Array]]:
+    def _get_train_step(self, donate_batch: bool):
+        fn = self._train_steps.get(donate_batch)
+        if fn is None:
+            if self._state_shardings is None:
+                raise RuntimeError("call init() before train_step()")
+            fn = self._build_train_step(donate_batch)
+            self._train_steps[donate_batch] = fn
+        return fn
+
+    def train_step(self, state, batch, rng, *,
+                   donate_batch: bool = False
+                   ) -> Tuple[Any, Dict[str, jax.Array]]:
+        """One async sharded step. ``donate_batch=True`` additionally
+        donates the batch buffers to the step program (no input
+        double-buffering) — callers must treat those device arrays as
+        CONSUMED; ``fit``'s streaming path opts in, DeviceEpochCache
+        consumers that replay batches across epochs must not."""
         # reliability hook: a FaultPlan can kill the Nth step to reproduce a
         # preemption bit-for-bit (a no-op global read when no plan is active)
         fault_site("trainer.train_step")
-        if self._train_step is None:
-            if self._state_shardings is None:
-                raise RuntimeError("call init() before train_step()")
-            self._train_step = self._build_train_step()
+        fn = self._get_train_step(donate_batch)
+        if self._ring is None:
+            self._ring = self._init_ring()
         with self.mesh:
-            out = self._train_step(state, batch, rng)
-        # Bound async dispatch depth: when nothing between steps touches the
-        # host (DeviceEpochCache consumers), hundreds of un-retired step
-        # programs can queue up and starve a collective rendezvous in the
-        # multi-device CPU runtime (7-of-8 threads arrive, the runtime
-        # aborts). Waiting on the loss from _THROTTLE steps back is free in
-        # steady state — it has long since computed — and caps the queue.
-        if self._throttled:
-            self._inflight.append(out[1]["loss"])
-            if len(self._inflight) > self._THROTTLE:
-                obssyncs.block_until_ready(self._inflight.pop(0),
-                                           "trainer.throttle")
-        return out
+            if donate_batch:
+                # batch donation is best-effort: leaves whose buffers cannot
+                # alias any output (labels vs param-shaped outputs) make XLA
+                # warn "donated buffers were not usable" at lowering — the
+                # expected cost of rank-aware donation, not a bug
+                import warnings
+                with warnings.catch_warnings():
+                    warnings.filterwarnings(
+                        "ignore",
+                        message="Some donated buffers were not usable")
+                    new_state, self._ring, metrics = fn(
+                        state, self._ring, batch, rng)
+            else:
+                new_state, self._ring, metrics = fn(
+                    state, self._ring, batch, rng)
+        # Steady state performs ZERO host syncs: the only wait is the ring
+        # flush every flush_steps, which on the multi-device CPU runtime
+        # also bounds async dispatch depth (hundreds of un-retired step
+        # programs can starve its collective rendezvous — 7-of-8 threads
+        # arrive, the runtime aborts). Real TPU runtimes bound their own
+        # launch queue, so only the CPU mesh pays the flush wait.
+        self._steps_since_flush += 1
+        if self._throttled and self._steps_since_flush >= self.flush_steps():
+            self.flush_metrics()
+        return new_state, metrics
+
+    def flush_metrics(self) -> Optional[Dict[str, np.ndarray]]:
+        """Fetch the device metrics ring: ONE counted host sync
+        (``trainer.flush``) retiring every step dispatched since the last
+        flush. Returns ``{"loss": (flush_steps,) float32, "step": int32}``
+        host values, or None when no step has run. Callers that want
+        periodic loss telemetry WITHOUT per-step syncs read it here."""
+        if self._ring is None:
+            return None
+        vals = obssyncs.device_get(self._ring, "trainer.flush")
+        self._steps_since_flush = 0
+        return {k: np.asarray(v) for k, v in vals.items()}
 
     def eval_step(self, state, batch, rng) -> jax.Array:
         if self._state_shardings is None:
@@ -380,8 +463,10 @@ class DistributedTrainer:
         no cost model; the MFU gauges are simply skipped then.
         """
         try:
+            fn = next(iter(self._train_steps.values()))
+            ring = self._ring if self._ring is not None else self._init_ring()
             with self.mesh:
-                cost = (self._train_step.lower(state, batch, rng)
+                cost = (fn.lower(state, ring, batch, rng)
                         .compile().cost_analysis())
             if isinstance(cost, (list, tuple)):  # older jax returns [dict]
                 cost = cost[0] if cost else {}
@@ -459,6 +544,10 @@ class DistributedTrainer:
             step_hist = obsmetrics.histogram("trainer.step_time_seconds")
             t_start = t_prev = obsevents.perf()
             sync_t0 = obssyncs.total()
+            # ring flushes are amortized bookkeeping, not per-step stalls:
+            # the steady-state gauge excludes them (tracked by site delta)
+            flush_t0 = obsmetrics.counter(
+                "observability.sync_points.trainer.flush").value
         prefetcher = DevicePrefetcher(batches, self.put_batch, depth=prefetch)
         # liveness: one beat per dispatched step — a wedged collective or
         # stuck input shows up as this heartbeat going silent, and the
@@ -467,7 +556,11 @@ class DistributedTrainer:
         try:
             for i, batch in enumerate(prefetcher):
                 hb.beat()
-                state, metrics = self.train_step(state, batch, rng)
+                rows = next(iter(batch.values())).shape[0] if batch else 0
+                # streaming batches are single-use device transfers, so the
+                # step donates them (no input double-buffering in HBM)
+                state, metrics = self.train_step(state, batch, rng,
+                                                 donate_batch=True)
                 losses.append(metrics["loss"])  # device scalar: no per-step sync
                 if telemetry:
                     # dispatch-to-dispatch wall time: non-blocking (the loss
@@ -478,15 +571,13 @@ class DistributedTrainer:
                     step_hist.observe(now - t_prev)
                     t_prev = now
                     steps += 1
-                    rows_total += (next(iter(batch.values())).shape[0]
-                                   if batch else 0)
+                    rows_total += rows
                     if self._flops_per_step is None:
                         self._flops_per_step = self._estimate_flops(
                             state, batch, rng)
                 if log_fn is not None and log_every and i % log_every == 0:
                     log_fn(i, float(losses[-1]))
                 elif metric_log is not None:  # cadence handled inside (no
-                    rows = next(iter(batch.values())).shape[0] if batch else 0
                     metric_log(i, {"loss": losses[-1]},  # sync off-cadence)
                                batch_rows=rows)
         finally:
@@ -496,15 +587,20 @@ class DistributedTrainer:
             if callable(closer):  # pipeline iterators own decode pools
                 closer()
         if telemetry and steps:
+            # the ROADMAP item-4 scoreboard, sampled BEFORE the epoch-end
+            # wait below and net of ring flushes: steady-state stepping
+            # itself performs zero host round trips, and this gauge reads
+            # exactly that (0.0) instead of charging the epoch's amortized
+            # bookkeeping to the step loop
+            flush_delta = (obsmetrics.counter(
+                "observability.sync_points.trainer.flush").value - flush_t0)
+            obsmetrics.gauge("train.sync_points_per_step").set(
+                max(0.0, obssyncs.total() - sync_t0 - flush_delta) / steps)
             # one sync per EPOCH (the exit paths below all wait on the last
             # loss anyway) so throughput covers completed device work, not
             # just async dispatch
             obssyncs.block_until_ready(losses[-1],
                                        "trainer.epoch_telemetry")
-            # the ROADMAP item-4 scoreboard: host round trips amortized
-            # over the epoch's steps (0 is the target in cached lanes)
-            obsmetrics.gauge("train.sync_points_per_step").set(
-                (obssyncs.total() - sync_t0) / steps)
             self._finish_epoch_telemetry(steps, rows_total,
                                          obsevents.perf() - t_start)
         if not losses:

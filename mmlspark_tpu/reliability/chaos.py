@@ -18,7 +18,10 @@ The tentpole scenario (``mmlspark-tpu chaos --seed N``):
 
 Invariants asserted (the verdict JSON records each one):
 
-- ``params_bit_identical``   — chaos-run final params == reference params;
+- ``params_bit_identical``   — chaos-run final params == reference params,
+  with the trainer's device-resident metrics ring active and its flush
+  interval deliberately misaligned with the checkpoint interval (a flush
+  boundary that changed the stream would break this bit-for-bit check);
 - ``final_checkpoint_loads`` — a FRESH checkpointer restores the last step
   and it matches the in-memory state (no corrupt checkpoint survived);
 - ``server_stays_live``      — every ``/healthz`` poll answered 200;
@@ -449,22 +452,31 @@ def run_scenario(seed: int, outdir: str, total_steps: int = 8,
                  save_every: int = 2, requests: int = 12) -> Dict[str, Any]:
     """Train-kill-resume-then-serve under a seeded fault schedule; returns
     (and writes to ``outdir/chaos_verdict.json``) the verdict dict."""
+    from mmlspark_tpu.utils import config as mmlconfig
+
     os.makedirs(outdir, exist_ok=True)
     errors: List[str] = []
+    # flush interval deliberately COPRIME with save_every: the device
+    # metrics ring's flush boundary lands mid-checkpoint-interval, so the
+    # bit-identical-resume invariant proves the ring is pure telemetry —
+    # where the kill falls relative to a flush must not change the stream
+    flush_steps = max(3, save_every * 2 + 1)
     verdict: Dict[str, Any] = {"seed": seed, "total_steps": total_steps,
-                               "save_every": save_every}
+                               "save_every": save_every,
+                               "metrics_flush_steps": flush_steps}
 
     batch_fn = _batch_fn(seed)
-    ref_state, _ = _run_loop_to_completion(
-        os.path.join(outdir, "ref"), batch_fn, total_steps, save_every,
-        max_restarts=0)
-
+    prior_flush = mmlconfig.get("train.metrics_flush_steps")
+    mmlconfig.set("train.metrics_flush_steps", flush_steps)
     chaos_dir = os.path.join(outdir, "chaos")
     plan = generate_train_plan(seed, total_steps)
     bit_identical = False
     final_loads = False
     restarts = 0
     try:
+        ref_state, _ = _run_loop_to_completion(
+            os.path.join(outdir, "ref"), batch_fn, total_steps, save_every,
+            max_restarts=0)
         with plan:
             state, restarts = _run_loop_to_completion(
                 chaos_dir, batch_fn, total_steps, save_every,
@@ -473,6 +485,8 @@ def run_scenario(seed: int, outdir: str, total_steps: int = 8,
         final_loads = _final_checkpoint_loads(chaos_dir, state, total_steps)
     except Exception as e:
         errors.append(f"train phase: {type(e).__name__}: {e}")
+    finally:
+        mmlconfig.set("train.metrics_flush_steps", prior_flush)
     verdict["train"] = {"restarts": restarts, "faults": plan.triggered,
                         "quarantined": _quarantined(chaos_dir)}
 

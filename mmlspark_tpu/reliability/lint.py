@@ -53,6 +53,15 @@ loses a request when its replica dies. All cross-replica traffic goes
 through the Router; deliberate direct calls (a rollout warming a
 drained replica) mark the line ``# lint: allow-direct-replica``.
 
+Rule 9 — compile sites (``<x>.lower(...).compile()`` or ``jax.jit(...)``)
+in ``serve/`` outside ``compile_cache.py``: an unsanctioned compile in
+the serving layer bypasses the persistent AOT program cache, so every
+replica cold-start and rollout warm pays the full XLA compile the cache
+exists to kill — and the ``compile_cache.*`` hit/miss counters stop
+telling the truth. All serve-side compilation goes through
+``compile_cache.load_or_compile``; deliberate exceptions mark the line
+``# lint: allow-compile``.
+
 Shared core for ``tools/check_reliability.py`` (standalone CLI),
 ``mmlspark-tpu check`` (installed CLI), and the in-pytest gate
 (tests/test_reliability_lint.py) — same single source of truth pattern as
@@ -108,6 +117,10 @@ _ALLOW_REPLICA = "# lint: allow-direct-replica"
 # breaker/retry wrapper layer)
 _REPLICA_HOME = "serve/router.py"
 _REPLICA_CALLS = ("submit", "submit_async", "submit_many", "score")
+_ALLOW_COMPILE = "# lint: allow-compile"
+# the ONE module allowed to compile serve-side programs (it IS the
+# persistent AOT cache seam)
+_COMPILE_HOME = "compile_cache.py"
 
 
 def _is_raw_sync(call: ast.Call) -> bool:
@@ -146,6 +159,28 @@ def _is_direct_replica_call(call: ast.Call) -> bool:
     return "replica" in name.lower()
 
 
+def _is_compile_site(call: ast.Call) -> bool:
+    """A serve-side compilation entry point: ``<x>.lower(...).compile()``
+    (or ``.compile()`` on a name mentioning ``lower``, the two-statement
+    spelling), ``jax.jit(...)``, or a bare ``jit(...)`` call. The
+    receiver-mentions-``lower`` requirement keeps ``re.compile(...)`` and
+    other unrelated ``.compile()`` methods out of scope."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "compile":
+        v = f.value
+        # jitted.lower(args).compile() — chained form
+        if (isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "lower"):
+            return True
+        # lowered.compile() — the receiver name carries the evidence
+        name = v.id if isinstance(v, ast.Name) else (
+            v.attr if isinstance(v, ast.Attribute) else "")
+        return "lower" in name.lower()
+    if isinstance(f, ast.Attribute) and f.attr == "jit":
+        return True
+    return isinstance(f, ast.Name) and f.id == "jit"
+
+
 def _is_signal_signal(call: ast.Call) -> bool:
     """``signal.signal(...)`` (or any ``<x>.signal(...)`` attribute call on
     a name ending in ``signal``) — the handler-installation form. A bare
@@ -166,6 +201,8 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     sync_home = norm.endswith(_SYNC_HOME)
     # Rule 8 scope: serve/ modules only (the fleet layer), router exempt
     replica_scoped = "serve/" in norm and not norm.endswith(_REPLICA_HOME)
+    # Rule 9 scope: serve/ modules only, the compile-cache seam exempt
+    compile_scoped = "serve/" in norm and not norm.endswith(_COMPILE_HOME)
 
     def _allowed(lineno: int) -> bool:
         # marker anywhere on the offending line opts that line out
@@ -183,6 +220,10 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     def _replica_allowed(lineno: int) -> bool:
         return (0 < lineno <= len(lines)
                 and _ALLOW_REPLICA in lines[lineno - 1])
+
+    def _compile_allowed(lineno: int) -> bool:
+        return (0 < lineno <= len(lines)
+                and _ALLOW_COMPILE in lines[lineno - 1])
 
     for node in ast.walk(tree):
         if (isinstance(node, ast.Call)
@@ -239,6 +280,15 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
                 f"outside {_REPLICA_HOME} (bypasses the router's breaker/"
                 "failover/fairness wrappers; route through Router.submit, "
                 f"or mark the line `{_ALLOW_REPLICA}`)")
+        elif (isinstance(node, ast.Call) and compile_scoped
+                and _is_compile_site(node)
+                and not _compile_allowed(node.lineno)):
+            problems.append(
+                f"{filename}:{node.lineno}: compile site in serve/ "
+                f"outside {_COMPILE_HOME} (bypasses the persistent AOT "
+                "program cache and its hit/miss accounting; route "
+                "through compile_cache.load_or_compile, or mark the "
+                f"line `{_ALLOW_COMPILE}`)")
         elif (isinstance(node, ast.Call) and _is_raw_sync(node)
                 and not sync_home
                 and not _sync_allowed(node.lineno)):

@@ -229,14 +229,22 @@ class Fleet:
               warm_x) -> None:
         """Warm the swapped entry before it takes traffic: build the
         apply (device-resident params), and when a sample is given score
-        it end to end so the bucket's program is AOT-compiled."""
+        it end to end so the bucket's program is AOT-compiled. The bucket
+        program funnels through :mod:`mmlspark_tpu.compile_cache`
+        (``ModelEntry._compile``), so with ``runtime.compile_cache_dir``
+        set each replica's warm LOADS the serialized executable instead of
+        recompiling — the per-replica rollout recompile tax this cache
+        exists to kill. The warm event carries the entry's hit/compile
+        counts so a rollout that silently recompiled is visible."""
         entry.ensure_apply()
         if warm_x is not None:
             rep.submit(name, warm_x)  # lint: allow-direct-replica
         if events.recording_enabled():
             events.emit("rollout", "warm", model=name,
                         version=entry.version, replica=rep.name,
-                        warmed=warm_x is not None)
+                        warmed=warm_x is not None,
+                        compile_cache_hits=entry.cache_hits,
+                        compiles=entry.compile_count)
 
     # -- lifecycle ----------------------------------------------------------
     def drain(self, reason: str = "drain") -> None:

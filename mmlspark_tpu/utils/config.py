@@ -23,6 +23,18 @@ _DEFAULTS: Dict[str, Any] = {
     "runtime.decode_threads": 0,      # 0 = native codec picks (ncpu)
     "runtime.mesh": "",               # launcher default, e.g. "data=-1,tensor=2"
     "runtime.device_cache_mb": 1024,  # HBM budget for device-resident epochs
+    "runtime.compile_cache_dir": "",  # non-empty = persist compiled XLA
+                                      # programs here: wires jax's
+                                      # jax_compilation_cache_dir for every
+                                      # jit path AND the serve-side AOT
+                                      # program cache (compile_cache.py) so
+                                      # restarts/rollouts skip bucket
+                                      # compiles (docs/PERFORMANCE.md)
+    # train (sync-free stepping; parallel/trainer.py, docs/PERFORMANCE.md)
+    "train.metrics_flush_steps": 16,  # steps between device->host metric
+                                      # ring flushes; also the dispatch-
+                                      # depth bound on the CPU mesh (the
+                                      # old throttle synced EVERY step)
     # data (streaming input pipeline; data/ package — see docs/DATA.md).
     # Values are validated at stage construction: window/workers must be
     # >= 1, prefetch_depth >= 0.

@@ -19,6 +19,21 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import bench  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _no_global_cache_enable(monkeypatch):
+    """bench.main()'s first act is wiring jax_compilation_cache_dir to the
+    repo-local .jax_cache — correct for the CLI process, but a PROCESS-WIDE
+    jax.config mutation that would leak into every later test file. On the
+    emulated multi-device CPU mesh, a persistent-cache *hit* on the sharded
+    donated train-step executable crashes the runtime (deserialize +
+    execute segfaults; reproducible at the seed with
+    JAX_COMPILATION_CACHE_DIR + min_compile_time 0), so the leak turns a
+    slow full-suite run — where step compiles cross the 1s write threshold
+    — into a crash two files later. Tests exercise main()'s contract, not
+    its cache side effect: drop the side effect."""
+    monkeypatch.setattr(bench, "_enable_compile_cache", lambda: None)
+
+
 def test_med_ratio_is_within_round_median():
     rounds = [[2.0, 4.0], [1.0, 3.0], [2.0, 2.0]]
     # ratios num/den per round: 2.0, 3.0, 1.0 -> median 2.0

@@ -573,3 +573,15 @@ def test_chaos_soak_across_seeds(tmp_path):
     for seed in (1, 2, 3, 5, 8):
         verdict = run_scenario(seed, str(tmp_path / f"seed{seed}"))
         assert verdict["passed"], verdict
+
+
+def test_chaos_train_ring_flush_misaligned_with_checkpoints(tmp_path):
+    """ISSUE 8 satellite: the kill/resume scenario runs with the trainer's
+    device metrics ring active and a flush interval that is NOT a multiple
+    of the checkpoint interval — a flush boundary that changed the stream
+    would break the bit-identical invariant."""
+    verdict = run_scenario(3, str(tmp_path / "chaos"))
+    assert verdict["passed"], verdict
+    flush = verdict["metrics_flush_steps"]
+    assert flush % verdict["save_every"] != 0, (flush, verdict["save_every"])
+    assert verdict["invariants"]["params_bit_identical"]

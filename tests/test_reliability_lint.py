@@ -340,3 +340,55 @@ def test_replica_rule_marker_and_non_replica_receivers():
         def also_fine(replica):
             return replica.health()
     """), filename="mmlspark_tpu/serve/fleet.py") == []
+
+
+# -- rule 9: compile sites in serve/ -----------------------------------------
+
+def test_flags_compile_sites_in_serve():
+    src = textwrap.dedent("""
+        import jax
+
+        def build(jitted, params, spec, x):
+            return jitted.lower(params, x).compile()
+
+        def two_step(lowered):
+            return lowered.compile()
+
+        def wrap(fn):
+            return jax.jit(fn, donate_argnums=(0,))
+    """)
+    probs = lint.check_source(
+        src, filename="mmlspark_tpu/serve/registry.py")
+    assert len(probs) == 3
+    assert all("compile site" in p for p in probs)
+    assert "allow-compile" in probs[0]          # the escape hatch is named
+    assert "compile_cache" in probs[0]          # and the sanctioned seam
+
+
+def test_compile_rule_scoped_to_serve_and_seam_exempt():
+    src = textwrap.dedent("""
+        def build(jitted, params, x):
+            return jitted.lower(params, x).compile()
+    """)
+    # the cache module IS the compile seam: its compile is the point
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/compile_cache.py") == []
+    # outside serve/ the rule does not apply (the trainer's AOT lowering
+    # and cost analysis legitimately compile)
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/parallel/trainer.py") == []
+
+
+def test_compile_rule_marker_and_unrelated_compiles():
+    assert lint.check_source(textwrap.dedent("""
+        import re
+
+        def build(jitted, params, x):
+            return jitted.lower(params, x).compile()  # lint: allow-compile
+
+        def regex(pat):
+            return re.compile(pat)
+
+        def sqlish(query):
+            return query.compile()
+    """), filename="mmlspark_tpu/serve/server.py") == []
