@@ -406,6 +406,9 @@ def cmd_chaos(args, passthrough) -> int:
     under injected faults while polling /healthz. ``--scenario fleet``:
     kill a replica of an N-wide fleet under fire; zero dropped requests,
     scores bit-identical to a single server, deterministic schedule.
+    ``--scenario decode``: kill a replica MID-GENERATION; every sequence
+    completes via failover-restart from its prompt with token streams
+    bit-identical to a single server (seeded sampling).
     Writes ``chaos_verdict.json`` under --out; exit 0 iff every
     invariant held."""
     from mmlspark_tpu.reliability import chaos
@@ -413,6 +416,10 @@ def cmd_chaos(args, passthrough) -> int:
         os.getcwd(), f"chaos-{args.scenario}-seed{args.seed}")
     if args.scenario == "fleet":
         verdict = chaos.run_fleet_scenario(
+            args.seed, outdir, replicas=args.replicas,
+            requests=args.requests)
+    elif args.scenario == "decode":
+        verdict = chaos.run_decode_scenario(
             args.seed, outdir, replicas=args.replicas,
             requests=args.requests)
     else:
@@ -544,9 +551,11 @@ def main(argv: Optional[List[str]] = None) -> int:
              "kill-a-fleet-replica-under-fire); exits 0 iff all "
              "invariants hold")
     chaos_p.add_argument("--scenario", default="train",
-                         choices=["train", "fleet"],
+                         choices=["train", "fleet", "decode"],
                          help="train: kill+resume then serve under faults; "
-                         "fleet: kill one of N replicas mid-stream "
+                         "fleet: kill one of N replicas mid-stream; "
+                         "decode: kill a replica mid-generation, every "
+                         "sequence completes via failover-restart "
                          "(default: train)")
     chaos_p.add_argument("--seed", type=int, default=0,
                          help="fault-schedule seed (same seed => same "

@@ -236,6 +236,32 @@ def _store_entry(path: str, program, meta: Dict[str, Any],
     return True
 
 
+def _cached_compile(stem: str, meta: Dict[str, Any],
+                    fresh: Callable[[], Callable]) -> CacheResult:
+    """Shared load -> verify -> compile -> store body behind both public
+    entry points. ``stem`` is the filename stem (shape identity), ``fresh``
+    the closure that actually compiles when the cache cannot serve."""
+    root = cache_dir()
+    if not root:
+        _counter("bypasses").inc()
+        _event("bypass", reason="runtime.compile_cache_dir unset", **meta)
+        return CacheResult(fresh(), "bypass")
+    path = os.path.join(_aot_dir(root), stem + _SUFFIX)
+    fingerprint = device_fingerprint()
+    loaded = _load_entry(path, fingerprint)
+    if loaded is not None and loaded.source == "hit":
+        _counter("hits").inc()
+        _event("hit", path=path, **meta)
+        return loaded
+    source = loaded.source if loaded is not None else "miss"
+    if source == "miss":
+        _counter("misses").inc()
+        _event("miss", path=path, **meta)
+    program = fresh()
+    _store_entry(path, program, meta, fingerprint)
+    return CacheResult(program, source)
+
+
 def load_or_compile(model: str, version: str, bucket: int,
                     row_shape: Tuple[int, ...], dtype: Any,
                     jitted, params) -> CacheResult:
@@ -260,28 +286,47 @@ def load_or_compile(model: str, version: str, bucket: int,
     def fresh() -> Callable:
         return jitted.lower(params, spec).compile()
 
-    root = cache_dir()
-    if not root:
-        _counter("bypasses").inc()
-        _event("bypass", reason="runtime.compile_cache_dir unset", **meta)
-        return CacheResult(fresh(), "bypass")
-    path = os.path.join(
-        _aot_dir(root),
-        entry_key(model, version, bucket, tuple(row_shape), dtype_name)
-        + _SUFFIX)
-    fingerprint = device_fingerprint()
-    loaded = _load_entry(path, fingerprint)
-    if loaded is not None and loaded.source == "hit":
-        _counter("hits").inc()
-        _event("hit", path=path, **meta)
-        return loaded
-    source = loaded.source if loaded is not None else "miss"
-    if source == "miss":
-        _counter("misses").inc()
-        _event("miss", path=path, **meta)
-    program = fresh()
-    _store_entry(path, program, meta, fingerprint)
-    return CacheResult(program, source)
+    return _cached_compile(
+        entry_key(model, version, bucket, tuple(row_shape), dtype_name),
+        meta, fresh)
+
+
+def program_key(model: str, version: str, kind: str, shape_key: str) -> str:
+    """Filename stem for a generalized AOT program (the generative lane's
+    prefill/decode executables): identity is (model+version, program kind,
+    caller-provided shape string). Same header-carries-environment contract
+    as :func:`entry_key`."""
+    ident = "\x00".join([model, version, kind, shape_key])
+    return hashlib.sha256(ident.encode("utf-8")).hexdigest()[:40]
+
+
+def load_or_compile_program(model: str, version: str, kind: str,
+                            shape_key: str, jitted,
+                            *abstract_args: Any) -> CacheResult:
+    """Generalized sibling of :func:`load_or_compile` for programs whose
+    signature is richer than ``(params, x)`` — the generative lane's
+    bucketed prefill and decode executables take KV arenas, token ids,
+    position/block-table operands, and declare arena donation on the
+    jitted function itself.
+
+    ``abstract_args`` are exactly what ``jitted.lower`` receives: concrete
+    params trees and ``jax.ShapeDtypeStruct`` placeholders. Donation
+    semantics ride on ``jitted`` (``jax.jit(..., donate_argnums=...)``);
+    backends that cannot donate (CPU test mesh) warn harmlessly, so that
+    specific warning is silenced at the compile site here.
+    """
+    import warnings
+    meta = {"model": model, "version": version, "kind": kind,
+            "shape_key": shape_key}
+
+    def fresh() -> Callable:
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message=".*[Dd]onat")  # CPU: donation unsupported
+            return jitted.lower(*abstract_args).compile()
+
+    return _cached_compile(program_key(model, version, kind, shape_key),
+                           meta, fresh)
 
 
 def stats() -> Dict[str, int]:

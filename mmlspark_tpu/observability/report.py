@@ -25,6 +25,11 @@ print where the time went —
   ``serving.request`` events (p50/p99 total latency, mean queue/pad/compute
   split, batch occupancy) plus shed/expired counts, the shed rate, and
   tail-sampled slow-request trace ids;
+- generative serving: TTFT/ITL percentiles, token counts, KV-arena
+  occupancy and decode-step facts from the generate lane's
+  ``generate.request`` / ``decode.step`` events, plus shed/expired
+  counts, fleet failover-restarts (``fleet.failover`` with
+  ``kind=generate``), and the slowest-TTFT exemplar trace ids;
 - fleet: router activity from ``fleet.*`` events (failovers by replica,
   fleet-wide sheds, tenant throttles, replica kills) and rollout progress
   from ``rollout.*`` events (shifted/warmed replicas per model version);
@@ -267,6 +272,54 @@ def build_report(path: str, top: int = 10,
         sv["expired"] = len(expired)
         report["serving"] = sv
 
+    # -- generative serving (generate.* + decode.* events) ----------------
+    gen_ev = [e for e in events if e.get("type") == "generate"]
+    dec_ev = [e for e in events if e.get("type") == "decode"]
+    if gen_ev or dec_ev:
+        gv: Dict[str, Any] = {}
+        greqs = [e for e in gen_ev if e.get("name") == "request"]
+        if greqs:
+            ttfts = sorted(float(e.get("ttft_ms", 0.0)) for e in greqs)
+            itls = sorted(float(e.get("itl_mean_ms", 0.0)) for e in greqs)
+            by_model: Dict[str, int] = defaultdict(int)
+            for e in greqs:
+                by_model[e.get("model", "?")] += 1
+            by_finish: Dict[str, int] = defaultdict(int)
+            for e in greqs:
+                by_finish[str(e.get("finish", "?"))] += 1
+            gv["requests"] = {
+                "completed": len(greqs),
+                "by_model": dict(sorted(by_model.items())),
+                "by_finish": dict(sorted(by_finish.items())),
+                "tokens": sum(int(e.get("tokens", 0)) for e in greqs),
+                "ttft_p50_ms": round(_pct(ttfts, 50), 3),
+                "ttft_p99_ms": round(_pct(ttfts, 99), 3),
+                "itl_p50_ms": round(_pct(itls, 50), 3),
+                "itl_p99_ms": round(_pct(itls, 99), 3),
+                "mean_kv_occupancy": round(
+                    _mean(greqs, "kv_occupancy"), 4)}
+            gv["slow_traces"] = [
+                {"trace_id": e.get("trace_id"),
+                 "ttft_ms": e.get("ttft_ms")}
+                for e in sorted(
+                    greqs, key=lambda e: -float(e.get("ttft_ms", 0.0))
+                )[:min(top, 3)]]
+        gshed = [e for e in gen_ev if e.get("name") == "shed"]
+        gv["shed"] = len(gshed)
+        gv["expired"] = len([e for e in gen_ev
+                             if e.get("name") == "expired"])
+        gv["failed_over"] = len([
+            e for e in events
+            if e.get("type") == "fleet" and e.get("name") == "failover"
+            and e.get("kind") == "generate"])
+        steps = [e for e in dec_ev if e.get("name") == "step"]
+        if steps:
+            gv["decode_steps"] = {
+                "count": len(steps),
+                "mean_active": round(_mean(steps, "active"), 2),
+                "mean_step_ms": round(_mean(steps, "step_ms"), 3)}
+        report["generate"] = gv
+
     # -- fleet (router + rollout) ------------------------------------------
     fleet_ev = [e for e in events if e.get("type") == "fleet"]
     rollout_ev = [e for e in events if e.get("type") == "rollout"]
@@ -483,6 +536,38 @@ def render_report(path: str, top: int = 10) -> str:
                        f"{len(sv['slow_traces'])} [{detail}]")
         out.append(f"  shed: {sv['shed']} ({sv['shed_rate']:.1f}% of "
                    f"offered), expired: {sv['expired']}")
+        out.append("")
+
+    if "generate" in r:
+        gv = r["generate"]
+        out.append("generative serving:")
+        if "requests" in gv:
+            rq = gv["requests"]
+            detail = ", ".join(f"{k}={v}"
+                               for k, v in rq["by_model"].items())
+            finish = ", ".join(f"{k}={v}"
+                               for k, v in rq["by_finish"].items())
+            out.append(
+                f"  requests: {rq['completed']} completed ({detail}); "
+                f"{rq['tokens']} tokens [{finish}]")
+            out.append(
+                f"  TTFT p50={rq['ttft_p50_ms']:.3f}ms "
+                f"p99={rq['ttft_p99_ms']:.3f}ms; "
+                f"ITL p50={rq['itl_p50_ms']:.3f}ms "
+                f"p99={rq['itl_p99_ms']:.3f}ms; "
+                f"KV occupancy mean={rq['mean_kv_occupancy']:.2f}")
+        if gv.get("slow_traces"):
+            detail = ", ".join(f"{t['trace_id']} ({t['ttft_ms']}ms)"
+                               for t in gv["slow_traces"])
+            out.append(f"  slowest TTFT traces: [{detail}]")
+        out.append(f"  shed: {gv['shed']}, expired: {gv['expired']}, "
+                   f"failed over (restarted): {gv['failed_over']}")
+        if "decode_steps" in gv:
+            ds = gv["decode_steps"]
+            out.append(
+                f"  decode steps: {ds['count']} "
+                f"(mean active={ds['mean_active']:.2f}, "
+                f"mean step={ds['mean_step_ms']:.3f}ms)")
         out.append("")
 
     if "fleet" in r:

@@ -446,6 +446,207 @@ def run_fleet_scenario(seed: int, outdir: str, replicas: int = 3,
     return verdict
 
 
+# -- decode scenario ---------------------------------------------------------
+
+def run_decode_scenario(seed: int, outdir: str, replicas: int = 2,
+                        requests: int = 5) -> Dict[str, Any]:
+    """Kill a replica mid-GENERATION; every sequence still completes.
+
+    Generation raises the stakes over the scoring-fleet scenario: a
+    sequence killed mid-decode loses its KV pages and its sampled prefix
+    — there is nothing to resume, only a RESTART from the prompt on a
+    survivor. The invariant that makes that restart correct is seeded
+    sampling: tokens are a pure function of (seed, position), so the
+    survivor replays the exact stream the dead replica was producing.
+
+    1. **reference** — every request generated on a single
+       :class:`~mmlspark_tpu.serve.server.Server`: the token ground truth.
+    2. **fleet** — the same requests through a ``replicas``-wide
+       :class:`~mmlspark_tpu.serve.fleet.Fleet`. One seeded request is
+       the victim: while it decodes (a seeded delay on the
+       ``generate.step`` fault site keeps it in flight long enough to be
+       observable), the harness watches per-replica decode-step counters
+       and kills the replica that is actually stepping it. The router
+       maps the death to a failover and restarts the sequence from its
+       prompt on a survivor.
+
+    Invariants (verdict JSON, ``outdir/chaos_verdict.json``):
+
+    - ``all_sequences_complete`` — every request returned a finished
+      token stream (``finish_reason`` length/stop), including the victim;
+    - ``tokens_bit_identical``   — fleet tokens == single-server tokens
+      for every request, THROUGH the kill and restart;
+    - ``failover_observed``      — the kill really forced >= 1 failover;
+    - ``no_unhandled_exceptions``— nothing escaped the router/retry
+      channel.
+    """
+    import threading
+
+    import numpy as np
+
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.serve.fleet import Fleet
+    from mmlspark_tpu.serve.server import Server
+    from mmlspark_tpu.utils import config as mmlconfig
+
+    os.makedirs(outdir, exist_ok=True)
+    errors: List[str] = []
+    verdict: Dict[str, Any] = {"seed": seed, "scenario": "decode",
+                               "replicas": replicas, "requests": requests}
+
+    rng = random.Random(seed ^ 0xDEC0DE)
+    kill_req = rng.randint(requests // 3, max(requests // 3,
+                                              (2 * requests) // 3))
+    prompts = [[rng.randrange(1, 200)
+                for _ in range(rng.randint(3, 8))]
+               for _ in range(requests)]
+    # the victim generates long enough that the kill lands mid-decode
+    max_new = [24 if i == kill_req else rng.randint(4, 8)
+               for i in range(requests)]
+
+    # a tiny arena keeps compile cost down; restore the config afterwards
+    prior = {k: mmlconfig.get(k) for k in
+             ("generate.max_seq_len", "generate.max_sequences",
+              "generate.kv_block_tokens")}
+    mmlconfig.set("generate.max_seq_len", 64)
+    mmlconfig.set("generate.max_sequences", 4)
+    mmlconfig.set("generate.kv_block_tokens", 8)
+    model = JaxModel().set_model("transformer_lm_tiny", seed=seed & 0xFFFF)
+
+    reference: List[List[int]] = []
+    results: List[Optional[Dict[str, Any]]] = []
+    killed_replica = ""
+    failovers = 0
+    route_log: List[str] = []
+    try:
+        # phase 1: single-server token ground truth
+        ref_server = Server({"lm": model})
+        try:
+            for i in range(requests):
+                reference.append(ref_server.generate(
+                    "lm", prompts[i], max_new_tokens=max_new[i],
+                    seed=seed + i, timeout=60)["tokens"])
+        finally:
+            ref_server.close()
+
+        # phase 2: the same requests through the fleet; the victim is
+        # killed mid-decode and must complete via failover-restart
+        fleet = Fleet({"lm": model}, replicas=replicas)
+        fleet.router.route_log = route_log
+        try:
+            for i in range(requests):
+                if i != kill_req:
+                    try:
+                        results.append(fleet.submit_generate(
+                            "lm", prompts[i], max_new_tokens=max_new[i],
+                            seed=seed + i))
+                    except Exception as e:
+                        results.append(None)
+                        errors.append(
+                            f"request {i}: {type(e).__name__}: {e}")
+                    continue
+                # victim request: client in a thread, kill from here the
+                # moment a replica's decode-step counter moves for it
+                base = {r.name: (r.server._lanes["lm"].steps
+                                 if "lm" in r.server._lanes else 0)
+                        for r in fleet.replicas}
+                box: Dict[str, Any] = {}
+
+                def _client(idx=i):
+                    try:
+                        box["out"] = fleet.submit_generate(
+                            "lm", prompts[idx],
+                            max_new_tokens=max_new[idx], seed=seed + idx)
+                    except Exception as e:   # recorded, not swallowed
+                        box["err"] = e
+
+                plan = FaultPlan(FaultSpec(
+                    "generate.step", on_hit=1, times=10_000,
+                    action="delay", delay=0.002))
+                with plan:
+                    t = threading.Thread(
+                        target=_client, daemon=True,
+                        name="mmlspark-tpu-chaos-decode-client")
+                    t.start()
+                    import time as _time
+                    deadline = _time.monotonic() + 30
+                    while (not killed_replica
+                           and _time.monotonic() < deadline):
+                        for j, rep in enumerate(fleet.replicas):
+                            lane = rep.server._lanes.get("lm")
+                            if (lane is not None
+                                    and lane.steps > base[rep.name]):
+                                fleet.kill(j)
+                                killed_replica = rep.name
+                                break
+                        _time.sleep(0.0005)
+                    t.join(60)
+                if not killed_replica:
+                    errors.append("kill never landed: no replica was "
+                                  "observed decoding the victim")
+                if t.is_alive():
+                    errors.append(f"request {i}: victim client wedged")
+                    results.append(None)
+                elif "err" in box:
+                    results.append(None)
+                    errors.append(f"request {i} (victim): "
+                                  f"{type(box['err']).__name__}: "
+                                  f"{box['err']}")
+                else:
+                    results.append(box.get("out"))
+            failovers = int(fleet.router.stats()["failovers"])
+        finally:
+            fleet.close()
+    except Exception as e:
+        errors.append(f"decode scenario: {type(e).__name__}: {e}")
+    finally:
+        for k, v in prior.items():
+            mmlconfig.set(k, v)
+
+    finished = [r is not None and r.get("finish_reason")
+                in ("length", "stop") for r in results]
+    identical = (len(results) == len(reference)
+                 and all(r is not None and r["tokens"] == ref
+                         for r, ref in zip(results, reference)))
+    verdict["schedule"] = {
+        "kill_request": kill_req, "killed_replica": killed_replica,
+        "max_new": max_new, "route_log": route_log,
+        "failovers": failovers,
+    }
+    verdict["decode"] = {
+        "completed": sum(finished),
+        "finish_reasons": [r.get("finish_reason") if r else None
+                           for r in results],
+        "ttft_ms": [round(r["ttft_ms"], 3) if r else None
+                    for r in results],
+    }
+    invariants = {
+        "all_sequences_complete": bool(results) and all(finished),
+        "tokens_bit_identical": identical,
+        "failover_observed": failovers >= 1,
+        "no_unhandled_exceptions": not errors,
+    }
+    verdict["invariants"] = invariants
+    verdict["errors"] = errors
+    verdict["passed"] = all(invariants.values())
+
+    path = os.path.join(outdir, VERDICT_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(verdict, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    _LOG.info("chaos decode verdict (%s): %s", path,
+              "PASS" if verdict["passed"] else "FAIL")
+    if not verdict["passed"]:
+        from mmlspark_tpu.observability import flightrec
+        dumped = flightrec.dump(
+            reason=f"chaos.decode.red.seed{seed}",
+            path=os.path.join(outdir, "chaos_flightrec.jsonl"))
+        if dumped:
+            _LOG.error("chaos: flight recorder dumped to %s", dumped)
+    return verdict
+
+
 # -- the scenario ------------------------------------------------------------
 
 def run_scenario(seed: int, outdir: str, total_steps: int = 8,

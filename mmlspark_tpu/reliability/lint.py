@@ -62,6 +62,16 @@ telling the truth. All serve-side compilation goes through
 ``compile_cache.load_or_compile``; deliberate exceptions mark the line
 ``# lint: allow-compile``.
 
+Rule 10 — device allocations (``jnp.zeros/ones/full/empty`` and their
+``_like`` forms, ``device_put``) in ``serve/`` outside
+``serve/kvcache.py``: serving-side HBM is a budgeted arena — params under
+the registry's ``runtime.device_cache_mb`` LRU, decode KV pages under the
+``KVCacheManager`` free list — and an ad-hoc allocation is invisible to
+both accountants, so occupancy gauges and eviction decisions quietly lie
+until the real device OOMs. All serve-side device memory goes through
+``KVCacheManager`` or ``ModelRegistry``; deliberate exceptions mark the
+line ``# lint: allow-alloc``.
+
 Shared core for ``tools/check_reliability.py`` (standalone CLI),
 ``mmlspark-tpu check`` (installed CLI), and the in-pytest gate
 (tests/test_reliability_lint.py) — same single source of truth pattern as
@@ -121,6 +131,12 @@ _ALLOW_COMPILE = "# lint: allow-compile"
 # the ONE module allowed to compile serve-side programs (it IS the
 # persistent AOT cache seam)
 _COMPILE_HOME = "compile_cache.py"
+_ALLOW_ALLOC = "# lint: allow-alloc"
+# the ONE serve/ module allowed to allocate device memory directly (it IS
+# the KV arena accountant; params are the registry's job)
+_ALLOC_HOME = "serve/kvcache.py"
+_ALLOC_CALLS = ("zeros", "ones", "full", "empty", "zeros_like",
+                "ones_like", "full_like", "empty_like")
 
 
 def _is_raw_sync(call: ast.Call) -> bool:
@@ -181,6 +197,24 @@ def _is_compile_site(call: ast.Call) -> bool:
     return isinstance(f, ast.Name) and f.id == "jit"
 
 
+def _is_device_alloc(call: ast.Call) -> bool:
+    """A device-memory allocation site: ``jnp.zeros(...)`` (or any of the
+    array factories in :data:`_ALLOC_CALLS` called on a receiver named
+    ``jnp`` or spelled ``jax.numpy``), plus ``device_put`` in any
+    spelling. Host-side ``np.zeros`` is NOT flagged — numpy arrays cost
+    host RAM, not the budgeted HBM the serve-side accountants track."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in _ALLOC_CALLS:
+        v = f.value
+        if isinstance(v, ast.Name):
+            return v.id == "jnp"
+        # jax.numpy.zeros(...) — the unaliased spelling
+        return isinstance(v, ast.Attribute) and v.attr == "numpy"
+    if isinstance(f, ast.Attribute) and f.attr == "device_put":
+        return True
+    return isinstance(f, ast.Name) and f.id == "device_put"
+
+
 def _is_signal_signal(call: ast.Call) -> bool:
     """``signal.signal(...)`` (or any ``<x>.signal(...)`` attribute call on
     a name ending in ``signal``) — the handler-installation form. A bare
@@ -203,6 +237,8 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     replica_scoped = "serve/" in norm and not norm.endswith(_REPLICA_HOME)
     # Rule 9 scope: serve/ modules only, the compile-cache seam exempt
     compile_scoped = "serve/" in norm and not norm.endswith(_COMPILE_HOME)
+    # Rule 10 scope: serve/ modules only, the KV-arena accountant exempt
+    alloc_scoped = "serve/" in norm and not norm.endswith(_ALLOC_HOME)
 
     def _allowed(lineno: int) -> bool:
         # marker anywhere on the offending line opts that line out
@@ -224,6 +260,10 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     def _compile_allowed(lineno: int) -> bool:
         return (0 < lineno <= len(lines)
                 and _ALLOW_COMPILE in lines[lineno - 1])
+
+    def _alloc_allowed(lineno: int) -> bool:
+        return (0 < lineno <= len(lines)
+                and _ALLOW_ALLOC in lines[lineno - 1])
 
     for node in ast.walk(tree):
         if (isinstance(node, ast.Call)
@@ -289,6 +329,15 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
                 "program cache and its hit/miss accounting; route "
                 "through compile_cache.load_or_compile, or mark the "
                 f"line `{_ALLOW_COMPILE}`)")
+        elif (isinstance(node, ast.Call) and alloc_scoped
+                and _is_device_alloc(node)
+                and not _alloc_allowed(node.lineno)):
+            problems.append(
+                f"{filename}:{node.lineno}: device allocation in serve/ "
+                f"outside {_ALLOC_HOME} (HBM the registry LRU and KV "
+                "arena accountants cannot see; route through "
+                "KVCacheManager/ModelRegistry, or mark the line "
+                f"`{_ALLOW_ALLOC}`)")
         elif (isinstance(node, ast.Call) and _is_raw_sync(node)
                 and not sync_home
                 and not _sync_allowed(node.lineno)):

@@ -5,12 +5,20 @@ scoring — see ``docs/SERVING.md`` for architecture, the ``serving.*`` /
 ``fleet.*`` config namespaces, and overload/retry/failover semantics.
 One :class:`Server` is a replica; a :class:`Fleet` is N of them behind a
 health-checked :class:`Router` with failover, per-tenant fairness, and
-zero-downtime rolling rollout.
+zero-downtime rolling rollout. The generative lane
+(:class:`GenerateLane` + :class:`KVCacheManager`) adds continuous-batched
+token decoding over a paged KV arena beside the scoring path.
 """
 from mmlspark_tpu.serve.batcher import (      # noqa: F401
     MicroBatcher, Ticket, bucket_for, default_buckets, parse_buckets,
 )
 from mmlspark_tpu.serve.fleet import Fleet, InProcessReplica  # noqa: F401
+from mmlspark_tpu.serve.generate import (      # noqa: F401
+    ContinuousBatcher, GenerateLane, GenerateRequest, GenerativeEntry,
+)
+from mmlspark_tpu.serve.kvcache import (       # noqa: F401
+    KVCacheManager, blocks_needed,
+)
 from mmlspark_tpu.serve.registry import ModelEntry, ModelRegistry  # noqa: F401
 from mmlspark_tpu.serve.router import (        # noqa: F401
     HttpReplica, ReplicaUnavailable, Router, TenantThrottled,
@@ -26,4 +34,6 @@ __all__ = [
     "ServeError", "ServerOverloaded", "RequestExpired", "ServerClosed",
     "Fleet", "InProcessReplica", "HttpReplica", "Router",
     "ReplicaUnavailable", "TenantThrottled", "WeightedFairAdmission",
+    "ContinuousBatcher", "GenerateLane", "GenerateRequest",
+    "GenerativeEntry", "KVCacheManager", "blocks_needed",
 ]

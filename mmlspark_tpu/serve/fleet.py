@@ -84,6 +84,30 @@ class InProcessReplica:
                     f"replica {self.name} died mid-request") from e
             raise
 
+    def submit_generate(self, model: str, prompt,
+                        max_new_tokens: Optional[int] = None,
+                        **kw) -> Dict:
+        """Generative counterpart of :meth:`submit`: blocks on the lane's
+        future and maps a dead/closed replica to
+        :class:`ReplicaUnavailable`. Generation state (KV blocks, sampled
+        tokens) dies with the replica, so the router RESTARTS the sequence
+        from its prompt on a survivor — seeded sampling makes the replay
+        token-identical."""
+        if self._dead:
+            raise ReplicaUnavailable(f"replica {self.name} is dead")
+        try:
+            fut = self.server.submit_generate(
+                model, prompt, max_new_tokens, **kw)
+            return fut.result()
+        except ServerClosed as e:
+            raise ReplicaUnavailable(
+                f"replica {self.name} closed") from e
+        except ServerOverloaded as e:
+            if self._dead or not self.server.health()["live"]:
+                raise ReplicaUnavailable(
+                    f"replica {self.name} died mid-generation") from e
+            raise
+
     def health(self) -> Dict[str, object]:
         if self._dead:
             return {"live": False, "ready": False, "state": "dead"}
@@ -142,6 +166,12 @@ class Fleet:
     def submit(self, model: str, x, deadline_ms: Optional[float] = None,
                **kw) -> np.ndarray:
         return self.router.submit(model, x, deadline_ms, **kw)
+
+    def submit_generate(self, model: str, prompt,
+                        max_new_tokens: Optional[int] = None,
+                        **kw) -> Dict:
+        return self.router.submit_generate(model, prompt,
+                                           max_new_tokens, **kw)
 
     def health(self) -> Dict[str, object]:
         return self.router.health()

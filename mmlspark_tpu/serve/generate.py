@@ -1,0 +1,857 @@
+"""Generative serving lane: continuous batching over a paged KV arena.
+
+The ``/score`` lane batches REQUESTS — admit, coalesce, one program call,
+respond. Autoregressive generation cannot ride that shape: one request is
+hundreds of sequential single-token steps, and naive request-batching
+either runs each sequence alone (device idle at batch 1) or locks a batch
+together until its LONGEST member finishes (finished sequences pad along,
+waiting prompts starve). This module is the decode-native lane:
+
+- :class:`GenerativeEntry` — the compiled half. One **prefill** program
+  per prompt-length bucket (the full flax module ``apply`` with KV rows
+  captured and scattered into the arena, so prefill numerics are the
+  served model's numerics by construction) and ONE single-token **decode**
+  program per batch-size bucket (hand-written forward over gathered KV
+  pages, numerically mirroring the module). All programs AOT-compile
+  through :meth:`GenerativeEntry._compile` — the generative twin of
+  ``ModelEntry._compile`` — into the persistent program cache, so a warm
+  replica restart pays ZERO compiles.
+- :class:`ContinuousBatcher` — the policy half, pure logic like
+  ``MicroBatcher``: sequences JOIN the in-flight batch the step a slot
+  frees and LEAVE the step they finish; nobody waits for anyone else's
+  completion.
+- :class:`GenerateLane` — the executor half: a single thread owning the
+  arena; each pass admits joiners (prefill + first sampled token = TTFT),
+  then runs one bucketed decode step over the whole active set.
+
+Admission reserves a sequence's FULL block budget (prompt + max-new) up
+front from the :class:`~mmlspark_tpu.serve.kvcache.KVCacheManager`; when
+the free list cannot cover it the request sheds with a retryable
+``ServerOverloaded`` — decode never OOMs mid-flight and the fleet router
+retries elsewhere. Sampling (greedy, temperature/top-k) is seeded per
+(seed, position), so a failover RESTART from the prompt on a surviving
+replica replays the exact token stream.
+
+Decode steps donate the arena buffers (in-place on TPU); the arena's
+attention runs the same fused Pallas flash path as scoring on real chips
+(prefill attention goes through ``full_attention`` inside the module) and
+the jnp reference on the CPU test mesh.
+"""
+from __future__ import annotations
+
+import math
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.observability import events, metrics, spans, syncs
+from mmlspark_tpu.reliability import watchdog as _watchdog
+from mmlspark_tpu.reliability.faults import fault_site
+from mmlspark_tpu.serve.batcher import bucket_for, default_buckets
+from mmlspark_tpu.serve.kvcache import (
+    RESERVED_BLOCK, KVCacheManager, blocks_needed,
+)
+from mmlspark_tpu.utils import config as mmlconfig
+from mmlspark_tpu.utils.logging import get_logger
+
+logger = get_logger("serve.generate")
+
+_STOP = object()
+
+
+# ---------------------------------------------------------------------------
+# buckets
+
+
+def parse_prefill_buckets(text: str, max_seq_len: int,
+                          block_tokens: int) -> Tuple[int, ...]:
+    """``generate.prefill_buckets`` config -> ascending bucket tuple.
+    Every bucket must be a multiple of ``block_tokens`` (prefill scatters
+    whole blocks) and the ladder must cover ``max_seq_len``. "" derives
+    powers of two from ``block_tokens`` up to ``max_seq_len``."""
+    if text.strip():
+        vals = sorted({int(v) for v in text.split(",") if v.strip()})
+    else:
+        vals, b = [], block_tokens
+        while b < max_seq_len:
+            vals.append(b)
+            b *= 2
+        vals.append(b)
+    bad = [v for v in vals if v < 1 or v % block_tokens]
+    if bad:
+        raise ValueError(
+            f"prefill buckets must be positive multiples of "
+            f"kv_block_tokens={block_tokens}, got {bad}")
+    if vals[-1] < max_seq_len:
+        raise ValueError(
+            f"largest prefill bucket {vals[-1]} < max_seq_len "
+            f"{max_seq_len}; the longest admissible prompt would have no "
+            "compiled shape")
+    return tuple(vals)
+
+
+# ---------------------------------------------------------------------------
+# sampling — host-side, deterministic per (seed, position) so a failover
+# restart from the prompt replays the identical token stream
+
+
+def sample_token(logits: np.ndarray, *, temperature: float, top_k: int,
+                 seed: int, position: int) -> int:
+    """One next-token draw from a (vocab,) logits row. ``temperature <= 0``
+    is greedy (pure argmax, no RNG at all); otherwise top-k + temperature
+    with an RNG derived from (seed, position) — the same (seed, position)
+    always yields the same token regardless of replica or retry."""
+    if temperature <= 0:
+        return int(np.argmax(logits))
+    scaled = logits.astype(np.float64) / float(temperature)
+    if top_k > 0 and top_k < scaled.size:
+        cutoff = np.partition(scaled, -top_k)[-top_k]
+        scaled = np.where(scaled < cutoff, -np.inf, scaled)
+    scaled = scaled - scaled.max()
+    p = np.exp(scaled)
+    p /= p.sum()
+    rng = np.random.default_rng((int(seed) & 0x7FFFFFFF, int(position)))
+    return int(rng.choice(p.size, p=p))
+
+
+# ---------------------------------------------------------------------------
+# numerics mirrored from models/zoo/transformer.py — the decode program
+# recomputes the module's math one token at a time. Flax formulas are
+# reproduced exactly (LayerNorm's clamped variance, tanh-approximate gelu,
+# fp32 norms and logits) so greedy decode is token-identical to a full
+# forward pass of the same sequence.
+
+
+def _layer_norm(x, scale, bias, eps: float = 1e-6):
+    xf = x.astype(np.float32)
+    mean = xf.mean(axis=-1, keepdims=True)
+    mean2 = (xf * xf).mean(axis=-1, keepdims=True)
+    import jax
+    import jax.numpy as jnp
+    var = jnp.maximum(0.0, mean2 - mean * mean)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return y * scale.astype(np.float32) + bias.astype(np.float32)
+
+
+def _dense(x, p, dtype):
+    import jax.numpy as jnp
+    return jnp.dot(x.astype(dtype), p["kernel"].astype(dtype)) \
+        + p["bias"].astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# requests and sequences
+
+
+@dataclass
+class GenerateRequest:
+    """One admitted generation ask (the ``/generate`` wire shape)."""
+    model: str
+    prompt: Sequence[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+    eos_id: Optional[int] = None
+    deadline_ms: Optional[float] = None
+    trace_id: str = ""
+
+
+class _Seq:
+    """One in-flight sequence: prompt, sampled tokens, leased blocks, and
+    the latency ledger (TTFT + inter-token gaps) its caller is owed."""
+
+    __slots__ = ("seq_id", "prompt", "max_new", "temperature", "top_k",
+                 "seed", "eos_id", "future", "trace_id", "enqueued",
+                 "deadline", "generated", "ttft_s", "last_t", "itl_s",
+                 "finish")
+
+    def __init__(self, seq_id: str, req: GenerateRequest, future: Future,
+                 enqueued: float, deadline: Optional[float]):
+        self.seq_id = seq_id
+        self.prompt = np.asarray(req.prompt, np.int32).ravel()
+        self.max_new = int(req.max_new_tokens)
+        self.temperature = float(req.temperature)
+        self.top_k = int(req.top_k)
+        self.seed = int(req.seed)
+        self.eos_id = req.eos_id
+        self.future = future
+        self.trace_id = req.trace_id
+        self.enqueued = enqueued
+        self.deadline = deadline
+        self.generated: List[int] = []
+        self.ttft_s: Optional[float] = None
+        self.last_t = enqueued
+        self.itl_s: List[float] = []
+        self.finish = ""
+
+    @property
+    def seq_len(self) -> int:
+        return len(self.prompt) + len(self.generated)
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+    def result(self) -> Dict[str, Any]:
+        itl = self.itl_s
+        return {
+            "tokens": list(self.generated),
+            "finish_reason": self.finish,
+            "ttft_ms": round((self.ttft_s or 0.0) * 1e3, 3),
+            "itl_mean_ms": round(sum(itl) / len(itl) * 1e3, 3) if itl
+            else 0.0,
+            "trace_id": self.trace_id,
+        }
+
+
+# ---------------------------------------------------------------------------
+# continuous batching policy (pure logic, injectable clock, no threads)
+
+
+class ContinuousBatcher:
+    """The continuous-batching sibling of
+    :class:`~mmlspark_tpu.serve.batcher.MicroBatcher`, speaking the same
+    ``offer``/``ready``/``wait_s``/``take`` vocabulary so the executor
+    loop reads identically — with one structural difference: ``take``
+    admits JOINERS into a persistent ``active`` set (capped at
+    ``max_sequences``) instead of flushing a transient group, and
+    :meth:`leave` retires a finished sequence the same step it finishes,
+    freeing its slot for the next waiter. Not thread-safe by itself; the
+    lane's single executor thread is the only caller."""
+
+    def __init__(self, max_sequences: int,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_sequences < 1:
+            raise ValueError(
+                f"max_sequences must be >= 1, got {max_sequences}")
+        self.max_sequences = int(max_sequences)
+        self.clock = clock
+        self._waiting: "deque[_Seq]" = deque()
+        self._active: List[_Seq] = []
+
+    def __len__(self) -> int:
+        return len(self._waiting)
+
+    @property
+    def active(self) -> List[_Seq]:
+        return list(self._active)
+
+    @property
+    def free_slots(self) -> int:
+        return self.max_sequences - len(self._active)
+
+    def offer(self, seq: _Seq) -> None:
+        self._waiting.append(seq)
+
+    def ready(self, now: Optional[float] = None) -> bool:
+        """A step is due whenever anything is decoding or a waiter can
+        join — continuous batching has no coalescing delay to wait out."""
+        if self._active:
+            return True
+        return bool(self._waiting) and self.free_slots > 0
+
+    def wait_s(self, now: Optional[float] = None) -> Optional[float]:
+        return 0.0 if self.ready(now) else None
+
+    def take(self, now: Optional[float] = None) -> List[_Seq]:
+        """Pop the joiners for THIS step: FIFO waiters up to the free
+        slots. The caller prefills each and confirms with :meth:`join`
+        (or sheds/expires it without joining)."""
+        out: List[_Seq] = []
+        while self._waiting and len(self._active) + len(out) \
+                < self.max_sequences:
+            out.append(self._waiting.popleft())
+        return out
+
+    def join(self, seq: _Seq) -> None:
+        if len(self._active) >= self.max_sequences:
+            raise ValueError("active set full; take() admitted too many")
+        self._active.append(seq)
+
+    def leave(self, seq: _Seq) -> None:
+        self._active.remove(seq)
+
+    def drain(self) -> List[_Seq]:
+        """Everything still owned by the batcher (waiting + active), for
+        shutdown paths. Leaves the batcher empty."""
+        out = list(self._waiting) + list(self._active)
+        self._waiting.clear()
+        self._active.clear()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# compiled programs
+
+
+class GenerativeEntry:
+    """Compiled generative artifacts for one registered model: the KV
+    arena plus bucketed prefill / decode executables.
+
+    :meth:`_compile` is THE generative compile seam — the twin of
+    ``ModelEntry._compile`` that tests wrap to assert one compile per
+    (kind, bucket) — and it funnels through
+    :func:`mmlspark_tpu.compile_cache.load_or_compile_program`, so every
+    program lands in the persistent on-disk cache and a warm replica
+    restart loads instead of compiling. Real compiles and cache loads
+    are accounted on the UNDERLYING ``ModelEntry`` (``compile_count`` /
+    ``cache_hits``), so registry stats and the bench gate see scoring and
+    generative compiles in one ledger.
+    """
+
+    def __init__(self, entry, *, max_seq_len: Optional[int] = None,
+                 max_sequences: Optional[int] = None):
+        self.entry = entry
+        apply = entry.ensure_apply()
+        if getattr(apply, "_mesh", None) is not None:
+            raise ValueError(
+                "generative lane needs a single-device model; "
+                f"{entry.name!r} is mesh-bound")
+        spec = entry.model._spec()
+        module = spec.get("module")
+        for attr in ("vocab", "dim", "depth", "heads", "max_len"):
+            if not hasattr(module, attr):
+                raise ValueError(
+                    f"model {entry.name!r} ({type(module).__name__}) is "
+                    "not a decoder LM; the generative lane serves "
+                    "TransformerLM-shaped architectures")
+        self.module = module
+        self.params = apply._params
+        self.vocab = int(module.vocab)
+        self.dim = int(module.dim)
+        self.depth = int(module.depth)
+        self.heads = int(module.heads)
+        self.head_dim = self.dim // self.heads
+        self.dtype = module.dtype
+        cap = int(max_seq_len if max_seq_len is not None
+                  else mmlconfig.get("generate.max_seq_len"))
+        self.max_seq_len = min(cap, int(module.max_len))
+        self.max_sequences = int(
+            max_sequences if max_sequences is not None
+            else mmlconfig.get("generate.max_sequences"))
+        self.kv = KVCacheManager.from_config(
+            layers=self.depth, heads=self.heads, head_dim=self.head_dim,
+            dtype=np.dtype(self.dtype))
+        self.block_tokens = self.kv.block_tokens
+        # block-table width: every sequence's table is padded to the
+        # blocks a max-length sequence needs, so ONE decode program shape
+        # serves every occupancy
+        self.table_width = blocks_needed(self.max_seq_len,
+                                         self.block_tokens)
+        self.prefill_buckets = parse_prefill_buckets(
+            str(mmlconfig.get("generate.prefill_buckets")),
+            self.max_seq_len, self.block_tokens)
+        self.decode_buckets = default_buckets(self.max_sequences)
+        self._programs: Dict[Tuple[str, int], Callable] = {}
+        # the arena is HBM this model now pins: charge it to the registry
+        # entry so the device-cache LRU sees params + arena as one tenant
+        entry.kv_arena_bytes = self.kv.arena_bytes()
+
+    # -- compile seam ------------------------------------------------------
+    def program_for(self, kind: str, bucket: int) -> Callable:
+        key = (kind, int(bucket))
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = self._compile(kind, int(bucket))
+            self._programs[key] = prog
+        return prog
+
+    def _compile(self, kind: str, bucket: int) -> Callable:
+        """Build (or cache-load) the executable for one (kind, bucket).
+        Every generative compilation funnels through here exactly once
+        per key — the compile-discipline tests wrap this method."""
+        from mmlspark_tpu import compile_cache
+        if kind == "prefill":
+            jitted, abstract = self._prefill_spec(bucket)
+        elif kind == "decode":
+            jitted, abstract = self._decode_spec(bucket)
+        else:
+            raise ValueError(f"unknown program kind {kind!r}")
+        shape_key = (f"{kind}:{bucket}|arena={self.kv.num_blocks}x"
+                     f"{self.block_tokens}x{self.heads}x{self.head_dim}"
+                     f"|layers={self.depth}|W={self.table_width}"
+                     f"|dtype={self.kv.dtype.name}")
+        result = compile_cache.load_or_compile_program(
+            self.entry.name, self.entry.version, kind, shape_key,
+            jitted, self.params, *abstract)
+        if result.hit:
+            self.entry.cache_hits += 1
+        else:
+            self.entry.compile_count += 1
+        return result.program
+
+    # -- prefill -----------------------------------------------------------
+    def _prefill_spec(self, bucket: int):
+        """Jitted prefill for one prompt-length bucket ``Lb``: run the
+        module's OWN apply (prefill numerics are the served model's by
+        construction), capture each block's K/V projections, scatter them
+        into the sequence's arena blocks, and return the last live
+        position's logits row."""
+        import jax
+        import jax.numpy as jnp
+        module, depth = self.module, self.depth
+        nb = bucket // self.block_tokens
+        bt, heads, hd = self.block_tokens, self.heads, self.head_dim
+
+        def kv_filter(mdl, _method):
+            return getattr(mdl, "name", None) in ("attn_key", "attn_value")
+
+        def prefill(params, arena_k, arena_v, tokens, last_pos, block_ids):
+            logits, state = module.apply(
+                params, tokens, capture_intermediates=kv_filter,
+                mutable=["intermediates"])
+            inter = state["intermediates"]
+            ks = jnp.stack([inter[f"block{i}"]["attn_key"]["__call__"][0][0]
+                            for i in range(depth)])
+            vs = jnp.stack([inter[f"block{i}"]["attn_value"]["__call__"][0]
+                            [0] for i in range(depth)])
+            ks = ks.reshape(depth, nb, bt, heads, hd)
+            vs = vs.reshape(depth, nb, bt, heads, hd)
+            arena_k = arena_k.at[:, block_ids].set(ks)
+            arena_v = arena_v.at[:, block_ids].set(vs)
+            row = jnp.take(logits[0], last_pos, axis=0)
+            return arena_k, arena_v, row
+
+        jitted = jax.jit(prefill, donate_argnums=(1, 2))  # lint: allow-compile
+        arena = jax.ShapeDtypeStruct(self.kv.arena_k.shape, self.kv.dtype)
+        abstract = (
+            arena, arena,
+            jax.ShapeDtypeStruct((1, bucket), np.int32),
+            jax.ShapeDtypeStruct((), np.int32),
+            jax.ShapeDtypeStruct((nb,), np.int32),
+        )
+        return jitted, abstract
+
+    # -- decode ------------------------------------------------------------
+    def _decode_spec(self, batch: int):
+        """Jitted single-token decode for one batch bucket ``B``: scatter
+        each lane's new K/V into its pages, gather the paged history, and
+        run one manually-unrolled forward step mirroring the module's
+        math. Lanes without a live sequence (``seq_lens == 0``) write to
+        the reserved scratch block and their logits are ignored host-side
+        — the compiled program never branches on occupancy."""
+        import jax
+        import jax.numpy as jnp
+        depth, heads, hd, dim = self.depth, self.heads, self.head_dim, \
+            self.dim
+        bt, W, dtype = self.block_tokens, self.table_width, self.dtype
+        scale = 1.0 / np.sqrt(hd)
+
+        def decode(params, arena_k, arena_v, tokens, positions,
+                   block_tables, seq_lens):
+            p = params.get("params", params)
+            table = p["token_embedding"]["embedding"]
+            x = jnp.take(table.astype(dtype), tokens, axis=0)
+            x = x + jnp.take(p["pos_embedding"][0], positions,
+                             axis=0).astype(x.dtype)
+            active = seq_lens > 0
+            blk_col = positions // bt
+            blk_idx = jnp.take_along_axis(
+                block_tables, blk_col[:, None], axis=1)[:, 0]
+            blk_idx = jnp.where(active, blk_idx, RESERVED_BLOCK)
+            offs = positions % bt
+            idx = jnp.arange(W * bt)
+            masked = idx[None, :] > positions[:, None]     # (B, K)
+            for i in range(depth):
+                blk = p[f"block{i}"]
+                y = _layer_norm(x, blk["norm1"]["scale"],
+                                blk["norm1"]["bias"])
+                q = _dense(y, blk["attn_query"], dtype)
+                k = _dense(y, blk["attn_key"], dtype)
+                v = _dense(y, blk["attn_value"], dtype)
+                qh = q.reshape(-1, heads, hd)
+                # scatter FIRST so the current token attends itself
+                arena_k = arena_k.at[i, blk_idx, offs].set(
+                    k.reshape(-1, heads, hd))
+                arena_v = arena_v.at[i, blk_idx, offs].set(
+                    v.reshape(-1, heads, hd))
+                k_all = arena_k[i][block_tables].reshape(
+                    -1, W * bt, heads, hd)
+                v_all = arena_v[i][block_tables].reshape(
+                    -1, W * bt, heads, hd)
+                s = jnp.einsum("bhd,bkhd->bhk", qh, k_all,
+                               preferred_element_type=jnp.float32) * scale
+                s = jnp.where(masked[:, None, :], -jnp.inf, s)
+                pr = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("bhk,bkhd->bhd", pr.astype(v_all.dtype),
+                               v_all,
+                               preferred_element_type=jnp.float32)
+                o = o.astype(qh.dtype)
+                x = x + _dense(o.reshape(-1, dim), blk["attn_out"], dtype)
+                y = _layer_norm(x, blk["norm2"]["scale"],
+                                blk["norm2"]["bias"])
+                h = _dense(y, blk["mlp_up"], dtype)
+                h = jax.nn.gelu(h)
+                x = x + _dense(h, blk["mlp_down"], dtype)
+            xf = _layer_norm(x, p["final_norm"]["scale"],
+                             p["final_norm"]["bias"])
+            logits = jnp.einsum("bd,vd->bv", xf.astype(jnp.float32),
+                                table.astype(jnp.float32))
+            return arena_k, arena_v, logits
+
+        jitted = jax.jit(decode, donate_argnums=(1, 2))  # lint: allow-compile
+        arena = jax.ShapeDtypeStruct(self.kv.arena_k.shape, self.kv.dtype)
+        abstract = (
+            arena, arena,
+            jax.ShapeDtypeStruct((batch,), np.int32),
+            jax.ShapeDtypeStruct((batch,), np.int32),
+            jax.ShapeDtypeStruct((batch, W), np.int32),
+            jax.ShapeDtypeStruct((batch,), np.int32),
+        )
+        return jitted, abstract
+
+    def release(self) -> None:
+        """Drop programs + arena accounting (lane shutdown)."""
+        self._programs.clear()
+        self.entry.kv_arena_bytes = 0
+
+
+# ---------------------------------------------------------------------------
+# the lane executor
+
+
+class GenerateLane:
+    """Single-threaded decode executor for one generative model.
+
+    Owns the arena and the active set; caller threads only touch the
+    admission queue and the (thread-safe) block ledger. ``start=False``
+    leaves the thread unstarted so tests drive :meth:`step` directly
+    under an injected clock.
+    """
+
+    def __init__(self, server, model: str, *, clock=None,
+                 start: bool = True):
+        self.server = server
+        self.model = model
+        self.clock = clock if clock is not None else server.clock
+        entry = server.registry.get(model)
+        self.gen = GenerativeEntry(entry)
+        server.registry.touch(entry)
+        self.batcher = ContinuousBatcher(self.gen.max_sequences,
+                                         clock=self.clock)
+        # deliberately unbounded: backpressure is the KV arena — submit()
+        # reserved every enqueued sequence's full block budget, so the
+        # queue can never hold more than the arena admits
+        self._queue: "queue.Queue" = queue.Queue(maxsize=0)
+        self._lock = threading.Lock()
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self._seq_ids = 0
+        self._admitted = server._twin("generate.admitted")
+        self._shed = server._twin("generate.shed")
+        self._expired = server._twin("generate.expired")
+        self._completed = server._twin("generate.completed")
+        self._failed = server._twin("generate.failed")
+        self.steps = 0          # decode steps taken (chaos kill trigger)
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name=f"mmlspark-tpu-generate-{self.model}",
+            daemon=True)
+        self._thread.start()
+
+    def close(self, timeout_s: Optional[float] = None) -> None:
+        """Stop the executor and fail everything unfinished with
+        :class:`ServerClosed` — generation state dies with the replica,
+        and the fleet router maps a closed replica to a failover that
+        RESTARTS the sequence from its prompt on a survivor (seeded
+        sampling replays the identical tokens). Idempotent."""
+        from mmlspark_tpu.serve.server import ServerClosed
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if timeout_s is None:
+            timeout_s = float(mmlconfig.get("serving.drain_timeout_s"))
+        if self._thread is not None:
+            self._queue.put(_STOP)
+            self._thread.join(timeout=max(timeout_s, 0.1))
+            self._thread = None
+        leftovers = [s for s in self._drain_queue() if s is not _STOP]
+        leftovers.extend(self.batcher.drain())
+        for seq in leftovers:
+            self.gen.kv.free(seq.seq_id)
+            if not seq.future.done():
+                self._failed.inc()
+                seq.future.set_exception(ServerClosed(
+                    "server closed mid-generation; restart from prompt "
+                    "elsewhere"))
+        self.gen.release()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -- admission (caller threads) ---------------------------------------
+    def submit(self, req: GenerateRequest) -> Future:
+        from mmlspark_tpu.serve.server import (
+            ServerClosed, ServerOverloaded, _mint_trace_id,
+        )
+        if self._closed:
+            raise ServerClosed("generate lane closed")
+        prompt = np.asarray(req.prompt, np.int32).ravel()
+        if prompt.size < 1:
+            raise ValueError("prompt must contain at least one token")
+        if prompt.size >= self.gen.max_seq_len:
+            raise ValueError(
+                f"prompt of {prompt.size} tokens leaves no room under "
+                f"generate.max_seq_len={self.gen.max_seq_len}")
+        max_new = min(int(req.max_new_tokens),
+                      self.gen.max_seq_len - int(prompt.size))
+        if max_new < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {req.max_new_tokens}")
+        req = GenerateRequest(
+            model=req.model, prompt=prompt, max_new_tokens=max_new,
+            temperature=req.temperature, top_k=req.top_k, seed=req.seed,
+            eos_id=req.eos_id, deadline_ms=req.deadline_ms,
+            trace_id=req.trace_id or _mint_trace_id())
+        now = self.clock()
+        deadline = now + req.deadline_ms / 1e3 if req.deadline_ms else None
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("generate lane closed")
+            self._seq_ids += 1
+            seq_id = f"{self.model}/s{self._seq_ids}"
+        # the whole lifetime's blocks up front: the prefill bucket's span
+        # now, the generated tail later — admission is the ONLY place a
+        # sequence can fail for memory
+        bucket = bucket_for(prompt.size, self.gen.prefill_buckets)
+        span_tokens = max(bucket, prompt.size + max_new)
+        fault_site("generate.enqueue", {"model": self.model,
+                                        "prompt": int(prompt.size)})
+        blocks = self.gen.kv.try_reserve(seq_id, span_tokens)
+        if blocks is None:
+            self._shed.inc()
+            if events.recording_enabled():
+                events.emit("generate", "shed", model=self.model,
+                            prompt=int(prompt.size), tokens=span_tokens,
+                            free_blocks=self.gen.kv.free_blocks,
+                            trace_id=req.trace_id)
+            raise ServerOverloaded(
+                f"KV arena full ({self.gen.kv.free_blocks} free blocks < "
+                f"{blocks_needed(span_tokens, self.gen.block_tokens)} "
+                "needed); retry with backoff",
+                retry_after=float(mmlconfig.get("serving.retry_after_s")))
+        seq = _Seq(seq_id, req, Future(), now, deadline)
+        seq.future.trace_id = req.trace_id
+        self._queue.put(seq)
+        self._admitted.inc()
+        return seq.future
+
+    # -- executor ----------------------------------------------------------
+    def _run(self) -> None:
+        hb = _watchdog.register(f"generate.{self.model}")
+        try:
+            self._run_loop(hb)
+        finally:
+            hb.close()
+
+    def _run_loop(self, hb) -> None:
+        stopping = False
+        while True:
+            hb.beat()
+            busy = self.batcher.ready()
+            try:
+                item = self._queue.get(timeout=0.0 if busy else 0.05)
+            except queue.Empty:
+                item = None
+            if item is _STOP:
+                stopping = True
+            elif item is not None:
+                self.batcher.offer(item)
+            for s in self._drain_queue():
+                if s is _STOP:
+                    stopping = True
+                else:
+                    self.batcher.offer(s)
+            if stopping:
+                return              # close() resolves whatever is left
+            if self.batcher.ready():
+                self.step()
+
+    def _drain_queue(self) -> List:
+        out: List = []
+        while True:
+            try:
+                out.append(self._queue.get_nowait())
+            except queue.Empty:
+                return out
+
+    # -- one continuous-batching step (public: tests drive it) ------------
+    def step(self) -> None:
+        """Admit joiners (prefill + first token), then run ONE decode step
+        over the active set. Sequences finishing this step leave and free
+        their blocks before the next step's joiners are considered."""
+        for s in self._drain_queue():
+            if s is not _STOP:
+                self.batcher.offer(s)
+        for seq in self.batcher.take():
+            self._admit_one(seq)
+        if self.batcher.active:
+            self._decode_step()
+        if metrics.metrics_enabled():
+            metrics.gauge("generate.kv_occupancy").set(
+                self.gen.kv.occupancy())
+
+    def _admit_one(self, seq: _Seq) -> None:
+        now = self.clock()
+        if seq.expired(now):
+            from mmlspark_tpu.serve.server import RequestExpired
+            self.gen.kv.free(seq.seq_id)
+            self._expired.inc()
+            if events.recording_enabled():
+                events.emit("generate", "expired", model=self.model,
+                            trace_id=seq.trace_id,
+                            waited_ms=round((now - seq.enqueued) * 1e3, 3))
+            seq.future.set_exception(RequestExpired(
+                "deadline passed before prefill"))
+            return
+        try:
+            self._prefill(seq)
+        except Exception as e:
+            logger.error("prefill failed for %s: %s", seq.seq_id, e)
+            self.gen.kv.free(seq.seq_id)
+            self._failed.inc()
+            if not seq.future.done():
+                seq.future.set_exception(e)
+            return
+        self.batcher.join(seq)
+        if seq.finish:              # eos / budget hit on the first token
+            self._finish(seq)
+
+    def _prefill(self, seq: _Seq) -> None:
+        gen = self.gen
+        Lp = int(seq.prompt.size)
+        bucket = bucket_for(Lp, gen.prefill_buckets)
+        nb = bucket // gen.block_tokens
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :Lp] = seq.prompt
+        block_ids = np.asarray(gen.kv.blocks_for(seq.seq_id)[:nb], np.int32)
+        program = gen.program_for("prefill", bucket)
+        fault_site("generate.prefill", {"model": self.model,
+                                        "bucket": bucket})
+        t0 = self.clock()
+        with spans.span("decode", "prefill", model=self.model,
+                        bucket=bucket):
+            ak, av, row = program(gen.params, gen.kv.arena_k,
+                                  gen.kv.arena_v, tokens,
+                                  np.int32(Lp - 1), block_ids)
+            gen.kv.swap(ak, av)
+            logits = np.asarray(
+                syncs.device_get(row, "generate.prefill"), np.float32)
+        now = self.clock()
+        self._append_token(seq, logits, position=Lp)
+        seq.ttft_s = now - seq.enqueued
+        seq.last_t = now
+        if metrics.metrics_enabled():
+            metrics.histogram("generate.ttft_ms").observe(
+                seq.ttft_s * 1e3, exemplar=seq.trace_id)
+        if events.recording_enabled():
+            events.emit("decode", "prefill", model=self.model,
+                        bucket=bucket, prompt=Lp,
+                        prefill_ms=round((now - t0) * 1e3, 3),
+                        trace_id=seq.trace_id)
+
+    def _decode_step(self) -> None:
+        gen = self.gen
+        active = self.batcher.active
+        bucket = bucket_for(len(active), gen.decode_buckets)
+        W = gen.table_width
+        tokens = np.zeros((bucket,), np.int32)
+        positions = np.zeros((bucket,), np.int32)
+        tables = np.full((bucket, W), RESERVED_BLOCK, np.int32)
+        seq_lens = np.zeros((bucket,), np.int32)
+        for i, seq in enumerate(active):
+            tokens[i] = seq.generated[-1]
+            positions[i] = seq.seq_len - 1      # the fed token's position
+            tables[i] = gen.kv.block_table(seq.seq_id, W)
+            seq_lens[i] = seq.seq_len
+        program = gen.program_for("decode", bucket)
+        fault_site("generate.step", {"model": self.model, "batch": bucket,
+                                     "active": len(active)})
+        t0 = self.clock()
+        with spans.span("decode", "step", model=self.model, batch=bucket,
+                        active=len(active)):
+            ak, av, logits = program(gen.params, gen.kv.arena_k,
+                                     gen.kv.arena_v, tokens, positions,
+                                     tables, seq_lens)
+            gen.kv.swap(ak, av)
+            rows = np.asarray(
+                syncs.device_get(logits, "generate.step"), np.float32)
+        now = self.clock()
+        self.steps += 1
+        hot = metrics.metrics_enabled()
+        for i, seq in enumerate(active):
+            self._append_token(seq, rows[i], position=seq.seq_len)
+            gap = now - seq.last_t
+            seq.last_t = now
+            seq.itl_s.append(gap)
+            if hot:
+                metrics.histogram("generate.itl_ms").observe(
+                    gap * 1e3, exemplar=seq.trace_id)
+            if not seq.finish and seq.expired(now):
+                seq.finish = "deadline"     # partial result, not an error
+            if seq.finish:
+                self._finish(seq)
+        if events.recording_enabled():
+            events.emit("decode", "step", model=self.model, batch=bucket,
+                        active=len(active),
+                        step_ms=round((now - t0) * 1e3, 3))
+
+    def _append_token(self, seq: _Seq, logits: np.ndarray,
+                      position: int) -> None:
+        tok = sample_token(logits, temperature=seq.temperature,
+                           top_k=seq.top_k, seed=seq.seed,
+                           position=position)
+        seq.generated.append(tok)
+        if seq.eos_id is not None and tok == seq.eos_id:
+            seq.finish = "stop"
+        elif len(seq.generated) >= seq.max_new:
+            seq.finish = seq.finish or "length"
+
+    def _finish(self, seq: _Seq) -> None:
+        self.batcher.leave(seq)
+        freed = self.gen.kv.free(seq.seq_id)
+        self._completed.inc()
+        now = self.clock()
+        if events.recording_enabled():
+            itl = seq.itl_s
+            events.emit("generate", "request", model=self.model,
+                        prompt=int(seq.prompt.size),
+                        tokens=len(seq.generated), finish=seq.finish,
+                        ttft_ms=round((seq.ttft_s or 0.0) * 1e3, 3),
+                        itl_mean_ms=round(sum(itl) / len(itl) * 1e3, 3)
+                        if itl else 0.0,
+                        itl_max_ms=round(max(itl) * 1e3, 3) if itl
+                        else 0.0,
+                        total_ms=round((now - seq.enqueued) * 1e3, 3),
+                        kv_occupancy=round(self.gen.kv.occupancy(), 4),
+                        trace_id=seq.trace_id)
+            events.emit("decode", "evict", model=self.model,
+                        blocks=freed, trace_id=seq.trace_id)
+        seq.future.set_result(seq.result())
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> Dict[str, float]:
+        s = {"admitted": self._admitted.value,
+             "shed": self._shed.value,
+             "expired": self._expired.value,
+             "completed": self._completed.value,
+             "failed": self._failed.value,
+             "waiting": len(self.batcher),
+             "active": len(self.batcher.active),
+             "steps": self.steps}
+        s.update({f"kv.{k}": v for k, v in self.gen.kv.stats().items()})
+        return s

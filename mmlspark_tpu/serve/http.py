@@ -13,6 +13,13 @@ JSON in, JSON out, zero new dependencies — the transport half of
   HTTP-native "retryable" signal — ``default_retryable`` already treats
   5xx as retryable on the client side), ``RequestExpired`` -> **504**,
   unknown model / malformed body -> **400**.
+- ``POST /generate`` — the generative lane: body ``{"model", "prompt":
+  [token ids], "max_new_tokens", "temperature", "top_k", "seed",
+  "deadline_ms", "trace_id"}`` -> ``{"tokens", "finish_reason",
+  "ttft_ms", "itl_mean_ms", "trace_id"}``. Same error mapping as
+  ``/score``; a KV-arena-full shed is a 503 with ``Retry-After``, and a
+  deadline lapsing MID-decode is a 200 with the partial stream
+  (``finish_reason: "deadline"``) — deadline accounting is per token.
 - ``GET /healthz`` — liveness AND readiness in one body
   (``{"status", "live", "ready", "state", "stats"}``): a draining server
   is still ``live`` (in-flight work finishes) but not ``ready`` (stop
@@ -113,6 +120,9 @@ def make_handler(server: Server):
                 self._reply(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
+            if self.path == "/generate":
+                self._post_generate()
+                return
             if self.path != "/score":
                 self._reply(404, {"error": f"no route {self.path}"})
                 return
@@ -166,6 +176,56 @@ def make_handler(server: Server):
                 if trace_id:
                     payload["trace_id"] = trace_id
                 self._reply(200, payload)
+
+        def _post_generate(self):
+            """``POST /generate`` — body ``{"model", "prompt": [ids...],
+            "max_new_tokens", "temperature", "top_k", "seed", "eos_id",
+            "deadline_ms", "trace_id"}``. Same error mapping as
+            ``/score`` (shed -> 503 + Retry-After, pre-prefill expiry ->
+            504, bad body -> 400). Deadline accounting is PER TOKEN: a
+            deadline that lapses mid-decode returns 200 with the partial
+            token stream and ``finish_reason: "deadline"`` — the tokens
+            already sampled are not worthless."""
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                if n > MAX_BODY_BYTES:
+                    self._reply(413, {"error": "body too large"})
+                    return
+                req = json.loads(self.rfile.read(n))
+                model = req["model"]
+                prompt = [int(t) for t in req["prompt"]]
+                kw = dict(
+                    max_new_tokens=req.get("max_new_tokens"),
+                    temperature=float(req.get("temperature", 0.0)),
+                    top_k=int(req.get("top_k", 0)),
+                    seed=int(req.get("seed", 0)),
+                    eos_id=req.get("eos_id"),
+                    deadline_ms=req.get("deadline_ms"),
+                    trace_id=str(req.get("trace_id") or "") or None)
+            except (KeyError, ValueError, TypeError) as e:
+                self._reply(400, {"error": f"bad request: {e}"})
+                return
+            try:
+                fut = server.submit_generate(model, prompt, **kw)
+                out = fut.result()
+            except ServerOverloaded as e:
+                after = getattr(e, "retry_after", None)
+                if after is None:
+                    after = 1.0 if server.draining else 0.0
+                self._reply(503, {"error": str(e), "retryable": True,
+                                  "retry_after": after},
+                            headers={"Retry-After": _fmt_after(after)})
+            except ServerClosed as e:
+                self._reply(503, {"error": str(e), "retryable": True},
+                            headers={"Retry-After": "1"})
+            except RequestExpired as e:
+                self._reply(504, {"error": str(e)})
+            except (KeyError, ValueError) as e:
+                self._reply(400, {"error": str(e)})
+            except ServeError as e:
+                self._reply(500, {"error": str(e)})
+            else:
+                self._reply(200, out)
 
     return Handler
 

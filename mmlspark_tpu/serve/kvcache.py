@@ -1,0 +1,216 @@
+"""Paged KV-cache arena for the generative serving lane.
+
+Autoregressive decode is memory-bound on the key/value history: a naive
+per-sequence ``(max_seq_len, heads, head_dim)`` allocation wastes HBM on
+short sequences and fragments it as sequences of different lengths join
+and leave the in-flight batch. This module is the vLLM-style answer
+(PAPERS.md: PagedAttention) sized for this framework: ONE fixed arena of
+``num_blocks`` fixed-size blocks per layer, allocated once at lane
+warm-up, with a host-side free list handing ``ceil(len / block_tokens)``
+blocks to each admitted sequence and reclaiming them the step the
+sequence finishes.
+
+Contracts the rest of the lane builds on:
+
+- **Fixed footprint.** The arena never grows. Admission that cannot get
+  its blocks is SHED (the server raises a retryable ``ServerOverloaded``)
+  — decode never OOMs mid-sequence, because a sequence's full block
+  budget (prompt + ``max_new_tokens``) is reserved up front.
+- **Block 0 is reserved scratch.** Decode programs run at a fixed batch
+  bucket; lanes without a live sequence route their (masked, garbage)
+  writes to block 0 so the compiled program never branches on occupancy.
+  Real sequences are handed blocks ``1..num_blocks-1`` only.
+- **Donation round-trip.** The decode/prefill executables donate the
+  arena buffers (in-place update on TPU); callers pass
+  ``arena_k``/``arena_v`` in and MUST store the returned pair back via
+  :meth:`swap` before the next step.
+- **Budget accounting.** ``arena_bytes()`` is charged to the owning
+  :class:`~mmlspark_tpu.serve.registry.ModelEntry` so the registry's
+  ``runtime.device_cache_mb`` LRU sees scoring params and decode arena
+  as one HBM tenant set (``generate.arena_mb`` sizes the arena itself;
+  0 derives it from ``generate.max_sequences`` x ``generate.max_seq_len``).
+
+This module is the ONE sanctioned device-allocation site in ``serve/``
+(lint Rule 10): everything else goes through the registry or marks an
+explicit ``# lint: allow-alloc``.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.observability import metrics
+from mmlspark_tpu.utils import config as mmlconfig
+from mmlspark_tpu.utils.logging import get_logger
+
+logger = get_logger("serve.kvcache")
+
+RESERVED_BLOCK = 0  # scratch target for masked decode lanes; never leased
+
+
+def blocks_needed(tokens: int, block_tokens: int) -> int:
+    """Blocks covering ``tokens`` positions at the arena granule."""
+    return max(1, math.ceil(int(tokens) / int(block_tokens)))
+
+
+class KVCacheManager:
+    """Fixed paged KV arena + host-side block ledger (thread-safe).
+
+    The device arrays are plain unsharded buffers shaped
+    ``(layers, num_blocks, block_tokens, heads, head_dim)``; the ledger
+    (free list + per-sequence leases) lives entirely on the host so
+    reserve/free never touch the device.
+    """
+
+    def __init__(self, *, layers: int, heads: int, head_dim: int,
+                 num_blocks: int, block_tokens: int, dtype=np.float32):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block {RESERVED_BLOCK} is "
+                f"reserved scratch), got {num_blocks}")
+        if block_tokens < 1:
+            raise ValueError(f"block_tokens must be >= 1, got {block_tokens}")
+        self.layers = int(layers)
+        self.heads = int(heads)
+        self.head_dim = int(head_dim)
+        self.num_blocks = int(num_blocks)
+        self.block_tokens = int(block_tokens)
+        self.dtype = np.dtype(dtype)
+        import jax.numpy as jnp
+        shape = (self.layers, self.num_blocks, self.block_tokens,
+                 self.heads, self.head_dim)
+        self.arena_k = jnp.zeros(shape, self.dtype)
+        self.arena_v = jnp.zeros(shape, self.dtype)
+        self._lock = threading.Lock()
+        # LIFO free list: recently-freed blocks are re-leased first, which
+        # keeps the hot working set compact in HBM
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._leases: Dict[str, List[int]] = {}
+        self._update_gauge()
+
+    # -- sizing ------------------------------------------------------------
+    @classmethod
+    def from_config(cls, *, layers: int, heads: int, head_dim: int,
+                    dtype=np.float32) -> "KVCacheManager":
+        """Size the arena from the ``generate.*`` config namespace:
+        ``generate.arena_mb`` when set, else enough blocks for
+        ``generate.max_sequences`` sequences of ``generate.max_seq_len``
+        tokens (plus the reserved scratch block)."""
+        bt = int(mmlconfig.get("generate.kv_block_tokens"))
+        arena_mb = float(mmlconfig.get("generate.arena_mb"))
+        if arena_mb > 0:
+            per_block = (2 * layers * bt * heads * head_dim
+                         * np.dtype(dtype).itemsize)
+            num_blocks = max(2, int(arena_mb * 1e6 // per_block))
+        else:
+            seqs = int(mmlconfig.get("generate.max_sequences"))
+            max_len = int(mmlconfig.get("generate.max_seq_len"))
+            num_blocks = 1 + seqs * blocks_needed(max_len, bt)
+        return cls(layers=layers, heads=heads, head_dim=head_dim,
+                   num_blocks=num_blocks, block_tokens=bt, dtype=dtype)
+
+    def arena_bytes(self) -> int:
+        """Total HBM footprint of both arenas (charged to the owning
+        registry entry so the device-cache LRU accounts for it)."""
+        per = (self.layers * self.num_blocks * self.block_tokens
+               * self.heads * self.head_dim * self.dtype.itemsize)
+        return 2 * per
+
+    # -- ledger ------------------------------------------------------------
+    def try_reserve(self, seq_id: str, tokens: int) -> Optional[List[int]]:
+        """Lease blocks covering ``tokens`` positions for ``seq_id``.
+        Returns the block ids (stable for the sequence's lifetime) or
+        None when the free list cannot cover the ask — the caller sheds
+        the request (retryable) instead of queueing into an OOM."""
+        n = blocks_needed(tokens, self.block_tokens)
+        with self._lock:
+            if seq_id in self._leases:
+                raise ValueError(f"sequence {seq_id!r} already holds blocks")
+            if len(self._free) < n:
+                return None
+            blocks = [self._free.pop() for _ in range(n)]
+            self._leases[seq_id] = blocks
+        self._update_gauge()
+        return list(blocks)
+
+    def free(self, seq_id: str) -> int:
+        """Return ``seq_id``'s blocks to the free list the moment it
+        finishes; idempotent (0 when nothing was held)."""
+        with self._lock:
+            blocks = self._leases.pop(seq_id, None)
+            if blocks:
+                self._free.extend(blocks)
+        if not blocks:
+            return 0
+        self._update_gauge()
+        return len(blocks)
+
+    def blocks_for(self, seq_id: str) -> List[int]:
+        with self._lock:
+            return list(self._leases.get(seq_id, ()))
+
+    def block_table(self, seq_id: str, width: int) -> np.ndarray:
+        """``seq_id``'s lease padded to ``width`` with the reserved
+        scratch block — one row of the decode program's block-table
+        operand."""
+        blocks = self.blocks_for(seq_id)
+        if len(blocks) > width:
+            raise ValueError(
+                f"{seq_id!r} holds {len(blocks)} blocks > table width "
+                f"{width}")
+        row = np.full((width,), RESERVED_BLOCK, np.int32)
+        row[:len(blocks)] = blocks
+        return row
+
+    @property
+    def leasable_blocks(self) -> int:
+        """Blocks a sequence can actually hold (excludes scratch)."""
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        with self._lock:
+            return sum(len(b) for b in self._leases.values())
+
+    @property
+    def active_sequences(self) -> int:
+        with self._lock:
+            return len(self._leases)
+
+    def occupancy(self) -> float:
+        """Leased fraction of the leasable arena (the KV-occupancy gauge
+        and report column)."""
+        return self.used_blocks / max(1, self.leasable_blocks)
+
+    # -- donation round-trip ----------------------------------------------
+    def swap(self, arena_k, arena_v) -> None:
+        """Store the (donated-and-returned) arena pair back after a
+        prefill/decode program call; the old references are dead buffers
+        on donating backends."""
+        self.arena_k = arena_k
+        self.arena_v = arena_v
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            used = sum(len(b) for b in self._leases.values())
+            return {
+                "blocks": self.num_blocks,
+                "block_tokens": self.block_tokens,
+                "used_blocks": used,
+                "free_blocks": len(self._free),
+                "sequences": len(self._leases),
+                "occupancy": used / max(1, self.num_blocks - 1),
+                "arena_bytes": self.arena_bytes(),
+            }
+
+    def _update_gauge(self) -> None:
+        if metrics.metrics_enabled():
+            metrics.gauge("generate.kv_occupancy").set(self.occupancy())

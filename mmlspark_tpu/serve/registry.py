@@ -52,6 +52,10 @@ class ModelEntry:
         self._compiled: Dict[Tuple, Callable] = {}
         self.compile_count = 0   # REAL compiles only (cache loads excluded)
         self.cache_hits = 0      # programs loaded from the persistent cache
+        self.kv_arena_bytes = 0  # decode KV arena charged by the
+                                 # generative lane (0 = no lane); counted
+                                 # into resident_bytes so the LRU budget
+                                 # sees params + arena as one tenant
         # per-model breaker: a model whose program keeps dying (OOM, bad
         # params after a hot-swap) fails FAST instead of burning executor
         # time per batch; other models on the same server keep serving
@@ -147,11 +151,13 @@ class ModelEntry:
 
     # -- residency ---------------------------------------------------------
     def resident_bytes(self) -> int:
-        """Param bytes this entry pins in HBM (0 when cold)."""
+        """HBM bytes this entry pins (0 when cold): params plus any
+        generative-lane KV arena charged against it."""
         if self._apply is None:
-            return 0
+            return self.kv_arena_bytes
         params = getattr(self._apply, "_params", None)
-        return _param_bytes(params) if params is not None else 0
+        return (_param_bytes(params) if params is not None else 0) \
+            + self.kv_arena_bytes
 
     @property
     def warm(self) -> bool:

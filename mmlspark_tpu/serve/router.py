@@ -401,20 +401,57 @@ class Router:
         if deadline_ms is not None and deadline_ms > 0:
             deadline = self.clock() + deadline_ms / 1e3
         self.fairness.admit(tenant, rows)
+
+        def call(h: _Handle, remaining_ms: Optional[float]):
+            return h.replica.submit(  # lint: allow-direct-replica
+                model, x, deadline_ms=remaining_ms, trace_id=trace_id)
+
         try:
-            return self._route(model, x, trace_id, deadline)
+            return self._route(model, call, trace_id, deadline)
         finally:
             self.fairness.release(tenant, rows)
 
-    def _route(self, model: str, x, trace_id: str,
-               deadline: Optional[float]) -> np.ndarray:
+    def submit_generate(self, model: str, prompt,
+                        max_new_tokens: Optional[int] = None, *,
+                        temperature: float = 0.0, top_k: int = 0,
+                        seed: int = 0, eos_id: Optional[int] = None,
+                        deadline_ms: Optional[float] = None,
+                        tenant: str = "default",
+                        trace_id: Optional[str] = None) -> Dict:
+        """Route one generation request with fleet semantics. Failover is
+        a RESTART: generation state (KV pages, sampled tokens) dies with
+        the replica, so the surviving replica replays the whole request
+        from its prompt — and because sampling is seeded per (seed,
+        position), the replayed stream is token-identical. Same
+        ``trace_id`` and the REMAINING deadline ride the retry."""
+        prompt = [int(t) for t in np.asarray(prompt).ravel()]
+        trace_id = trace_id or _mint_trace_id()
+        deadline = None
+        if deadline_ms is not None and deadline_ms > 0:
+            deadline = self.clock() + deadline_ms / 1e3
+        self.fairness.admit(tenant, 1)
+
+        def call(h: _Handle, remaining_ms: Optional[float]):
+            return h.replica.submit_generate(  # lint: allow-direct-replica
+                model, prompt, max_new_tokens, temperature=temperature,
+                top_k=top_k, seed=seed, eos_id=eos_id,
+                deadline_ms=remaining_ms, trace_id=trace_id)
+
+        try:
+            return self._route(model, call, trace_id, deadline,
+                               kind="generate")
+        finally:
+            self.fairness.release(tenant, 1)
+
+    def _route(self, model: str, call: Callable, trace_id: str,
+               deadline: Optional[float], kind: str = "score"):
         tried: set = set()
         sheds: List[Tuple[str, ServerOverloaded]] = []
         try:
             for attempt in self.failover_policy.attempts():
                 with attempt:
-                    return self._route_once(model, x, trace_id, deadline,
-                                            tried, sheds)
+                    return self._route_once(model, call, trace_id,
+                                            deadline, tried, sheds, kind)
         except _AllShed:
             pass  # consolidated below
         except (ReplicaUnavailable, CircuitOpen, ConnectionError) as e:
@@ -439,17 +476,20 @@ class Router:
             f"({', '.join(n for n, _ in sheds) or 'none ready'}); "
             "retry with backoff", retry_after=retry_after) from None
 
-    def _route_once(self, model: str, x, trace_id: str,
+    def _route_once(self, model: str, call: Callable, trace_id: str,
                     deadline: Optional[float], tried: set,
-                    sheds: List[Tuple[str, ServerOverloaded]]) -> np.ndarray:
+                    sheds: List[Tuple[str, ServerOverloaded]],
+                    kind: str = "score"):
         """One routing attempt: offer the request to ready replicas in WRR
         order. A shed moves on to the next candidate in THIS attempt; a
         dead replica raises so the failover policy retries (a fresh
-        attempt, this replica excluded)."""
+        attempt, this replica excluded). ``call(handle, remaining_ms)``
+        performs the actual replica call — scoring and generation share
+        this whole routing/failover/shed machinery."""
         while True:
             if deadline is not None and self.clock() >= deadline:
                 raise RequestExpired(
-                    f"deadline passed before a replica could score "
+                    f"deadline passed before a replica could answer "
                     f"(tried {sorted(tried)})")
             h = self._pick(frozenset(tried))
             if h is None:
@@ -462,8 +502,7 @@ class Router:
             if deadline is not None:
                 remaining_ms = max((deadline - self.clock()) * 1e3, 0.001)
             try:
-                out = self._call_replica(h, model, x, remaining_ms,
-                                         trace_id)
+                out = self._call_replica(h, call, remaining_ms)
             except ServerOverloaded as e:
                 # this replica is full/draining, not dead: same attempt,
                 # next candidate (don't charge the failover budget)
@@ -476,7 +515,7 @@ class Router:
                 raise  # client error: same everywhere, don't failover
             except ServerClosed as e:
                 self._mark_down(h, "closed")
-                self._emit_failover(h, trace_id, e)
+                self._emit_failover(h, trace_id, e, kind)
                 tried.add(h.name)
                 raise ReplicaUnavailable(
                     f"replica {h.name} closed mid-request") from e
@@ -485,7 +524,7 @@ class Router:
                 # dying replica: mark it down, let the RetryPolicy give
                 # this request its one failover on a healthy one
                 self._mark_down(h, "dead")
-                self._emit_failover(h, trace_id, e)
+                self._emit_failover(h, trace_id, e, kind)
                 tried.add(h.name)
                 raise
             h.routed.inc()
@@ -494,25 +533,23 @@ class Router:
             return out
 
     @staticmethod
-    def _call_replica(h: _Handle, model: str, x,
-                      remaining_ms: Optional[float],
-                      trace_id: str) -> np.ndarray:
+    def _call_replica(h: _Handle, call: Callable,
+                      remaining_ms: Optional[float]):
         """One raw replica call through its breaker. A replica that
         ANSWERS — even with a shed, an expired deadline, or a client
         error — is alive, so only transport-level failures feed the
         breaker's failure count; application answers record success."""
         answered: List[BaseException] = []
 
-        def call():
+        def guarded():
             try:
-                return h.replica.submit(  # lint: allow-direct-replica
-                    model, x, deadline_ms=remaining_ms, trace_id=trace_id)
+                return call(h, remaining_ms)
             except (ServerOverloaded, RequestExpired, KeyError, ValueError,
                     TypeError) as e:
                 answered.append(e)
                 return None
 
-        out = h.breaker.call(call)
+        out = h.breaker.call(guarded)
         if answered:
             raise answered[0]
         return out
@@ -523,13 +560,13 @@ class Router:
             h.state = state
 
     def _emit_failover(self, h: _Handle, trace_id: str,
-                       exc: BaseException) -> None:
+                       exc: BaseException, kind: str = "score") -> None:
         self._failovers.inc()
         logger.warning("failover off %s (%s: %s)", h.name,
                        type(exc).__name__, exc)
         if events.recording_enabled():
             events.emit("fleet", "failover", replica=h.name,
-                        trace_id=trace_id,
+                        trace_id=trace_id, kind=kind,
                         error=f"{type(exc).__name__}: {exc}")
 
     # -- Server-compatible surface (the HTTP front-end binds either) -------

@@ -179,8 +179,18 @@ class Server:
         self._expired = _Twin("serving.expired")
         self._completed = _Twin("serving.completed")
         self._failed = _Twin("serving.failed")
+        # generative lanes (serve/generate.py), one per decoder-LM model,
+        # created lazily on the first submit_generate
+        self._lanes: Dict[str, object] = {}
+        self._autostart = start
         if start:
             self.start()
+
+    @staticmethod
+    def _twin(name: str) -> _Twin:
+        """Per-instance + process-global counter pair (the generative lane
+        counts through the same twin scheme as the scoring path)."""
+        return _Twin(name)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -207,6 +217,8 @@ class Server:
             self._draining = True
         if timeout_s is None:
             timeout_s = float(mmlconfig.get("serving.drain_timeout_s"))
+        for lane in list(self._lanes.values()):
+            lane.close(timeout_s=timeout_s)
         if self._thread is not None:
             self._queue.put(_STOP)
             self._thread.join(timeout=max(timeout_s, 0.1))
@@ -366,6 +378,59 @@ class Server:
                timeout: Optional[float] = None) -> np.ndarray:
         """Blocking :meth:`submit_async`."""
         return self.submit_async(model, x, deadline_ms).result(timeout)
+
+    # -- generative lane ---------------------------------------------------
+    def enable_generate(self, model: str, *, clock=None,
+                        start: Optional[bool] = None):
+        """Create (or return) the generative lane for ``model`` — its own
+        executor thread, KV arena, and bucketed prefill/decode programs
+        (see :mod:`~mmlspark_tpu.serve.generate`). Lazy: plain scoring
+        servers never pay for an arena. ``start=False`` leaves the lane
+        thread unstarted for test-driven stepping."""
+        from mmlspark_tpu.serve.generate import GenerateLane
+        with self._admit:
+            if self._closed:
+                raise ServerClosed("server closed")
+            lane = self._lanes.get(model)
+            if lane is None:
+                lane = GenerateLane(
+                    self, model, clock=clock,
+                    start=self._autostart if start is None else start)
+                self._lanes[model] = lane
+        return lane
+
+    def submit_generate(self, model: str, prompt,
+                        max_new_tokens: Optional[int] = None, *,
+                        temperature: float = 0.0, top_k: int = 0,
+                        seed: int = 0, eos_id: Optional[int] = None,
+                        deadline_ms: Optional[float] = None,
+                        trace_id: Optional[str] = None) -> Future:
+        """Admit one generation request; the Future resolves to a dict
+        with ``tokens`` (sampled ids), ``finish_reason``, ``ttft_ms`` and
+        ``trace_id``. Sheds with retryable :class:`ServerOverloaded` when
+        the KV arena cannot hold the sequence's full block budget."""
+        from mmlspark_tpu.serve.generate import GenerateRequest
+        if self._closed:
+            raise ServerClosed("server closed")
+        if self._draining:
+            raise ServerOverloaded("server draining; retry elsewhere",
+                                   retry_after=1.0)
+        self.registry.get(model)   # KeyError surfaces here, early
+        if max_new_tokens is None:
+            max_new_tokens = int(mmlconfig.get("generate.max_new_tokens"))
+        lane = self.enable_generate(model)
+        return lane.submit(GenerateRequest(
+            model=model, prompt=prompt, max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k, seed=seed,
+            eos_id=eos_id, deadline_ms=deadline_ms,
+            trace_id=trace_id or ""))
+
+    def generate(self, model: str, prompt,
+                 max_new_tokens: Optional[int] = None,
+                 timeout: Optional[float] = None, **kw) -> Dict:
+        """Blocking :meth:`submit_generate`."""
+        return self.submit_generate(model, prompt, max_new_tokens,
+                                    **kw).result(timeout)
 
     def submit_many(self, model: str, x,
                     deadline_ms: Optional[float] = None,
@@ -568,4 +633,7 @@ class Server:
              "pending_rows": self._batcher.pending_rows}
         s.update({f"registry.{k}": v
                   for k, v in self.registry.stats().items()})
+        for name, lane in self._lanes.items():
+            s.update({f"generate.{name}.{k}": v
+                      for k, v in lane.stats().items()})
         return s

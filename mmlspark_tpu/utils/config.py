@@ -68,6 +68,24 @@ _DEFAULTS: Dict[str, Any] = {
     "serving.drain_timeout_s": 10.0,  # graceful-drain budget before close
     "serving.retry_after_s": 0.0,     # Retry-After hint on a queue-full
                                       # shed (draining replicas hint 1.0)
+    # generate (autoregressive decode lane; serve/generate.py + kvcache.py
+    # — see docs/SERVING.md "Generative lane" and the KV sizing runbook)
+    "generate.max_seq_len": 512,      # hard cap on prompt + generated
+    "generate.prefill_buckets": "",   # "" = powers of two up to max_seq_len
+                                      # starting at kv_block_tokens; else
+                                      # e.g. "32,128,512" (prompt-length
+                                      # buckets; one prefill program each)
+    "generate.kv_block_tokens": 16,   # tokens per paged KV block (the
+                                      # arena allocation granule)
+    "generate.max_sequences": 8,      # decode batch cap = in-flight
+                                      # sequence cap (batch-size buckets
+                                      # derive from it: {1, /4, /2, max})
+    "generate.max_new_tokens": 64,    # default generation budget per
+                                      # request (callers can lower/raise)
+    "generate.arena_mb": 0.0,         # fixed KV arena size; 0 = derive
+                                      # from max_sequences x max_seq_len.
+                                      # Accounted under
+                                      # runtime.device_cache_mb either way
     # fleet (multi-replica router + rolling rollout; see docs/SERVING.md)
     "fleet.replicas": 2,              # in-process replicas per Fleet
     "fleet.failover_attempts": 2,     # routing tries per request (1 = no

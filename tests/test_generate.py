@@ -1,0 +1,284 @@
+"""Generative serving lane (serve/generate.py + serve/kvcache.py).
+
+Everything runs on CPU with either an injected clock (batcher policy) or
+manually stepped lanes (``Server(start=False)`` + ``lane.step()``) — no
+sleeps, no background threads unless a test is explicitly about them.
+The acceptance spine, mirroring ``test_serving.py``:
+
+- greedy decode through the paged-KV continuous-batching lane is
+  BIT-IDENTICAL to the naive full-recompute reference loop;
+- finished sequences return their KV blocks the same step they finish;
+- an exhausted arena sheds at admission (retryable ``ServerOverloaded``),
+  never queues unboundedly;
+- at most one compile per (kind, bucket), and a restarted process with a
+  persistent program cache pays ZERO compiles.
+"""
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.observability import metrics
+from mmlspark_tpu.serve import Server, ServerOverloaded
+from mmlspark_tpu.serve.generate import (
+    ContinuousBatcher, GenerateRequest, _Seq, parse_prefill_buckets,
+    sample_token,
+)
+from mmlspark_tpu.serve.kvcache import KVCacheManager, blocks_needed
+from mmlspark_tpu.utils import config
+
+_GEN_KEYS = ("generate.max_seq_len", "generate.max_sequences",
+             "generate.kv_block_tokens", "generate.max_new_tokens",
+             "generate.arena_mb", "generate.prefill_buckets",
+             "runtime.compile_cache_dir")
+
+
+@pytest.fixture(autouse=True)
+def _small_lane_config():
+    prior = {k: config.get(k) for k in _GEN_KEYS}
+    config.set("generate.max_seq_len", 64)
+    config.set("generate.max_sequences", 4)
+    config.set("generate.kv_block_tokens", 8)
+    metrics.get_registry().reset()
+    yield
+    for k, v in prior.items():
+        config.set(k, v)
+    metrics.get_registry().reset()
+
+
+def _ticker(start=0.0):
+    state = {"now": float(start)}
+
+    def clock():
+        return state["now"]
+    clock.advance = lambda dt: state.__setitem__("now", state["now"] + dt)
+    return clock
+
+
+def _seq(seq_id="s", prompt=(1, 2), max_new=4, at=0.0, deadline=None):
+    req = GenerateRequest("m", list(prompt), max_new)
+    return _Seq(seq_id, req, future=None, enqueued=at, deadline=deadline)
+
+
+def make_lm(seed=0):
+    return JaxModel().set_model("transformer_lm_tiny", seed=seed)
+
+
+def _run_lane(srv, lane, futs, max_steps=64):
+    for _ in range(max_steps):
+        if all(f.done() for f in futs):
+            break
+        lane.step()
+    return [f.result(1) for f in futs]
+
+
+def _reference_greedy(srv, model, prompt, max_new):
+    """The loop a user writes first: full-context recompute per token
+    through the registry's own jitted apply."""
+    apply = srv.registry.get(model).ensure_apply()
+    toks = list(prompt)
+    for _ in range(max_new):
+        logits = np.asarray(
+            apply._jitted(apply._params, np.asarray([toks], np.int32)))
+        toks.append(int(np.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+# -- continuous-batching policy (pure, injected clock) -----------------------
+
+def test_batcher_joins_fifo_up_to_free_slots():
+    clock = _ticker()
+    b = ContinuousBatcher(max_sequences=2, clock=clock)
+    assert not b.ready() and b.wait_s() is None
+    for i in range(3):
+        b.offer(_seq(f"s{i}", at=clock()))
+    assert b.ready() and b.wait_s() == 0.0
+    joiners = b.take()
+    assert [s.seq_id for s in joiners] == ["s0", "s1"]   # FIFO, capped
+    for s in joiners:
+        b.join(s)
+    assert b.free_slots == 0 and len(b) == 1
+    assert b.take() == []                                # full: no joiners
+
+
+def test_batcher_leave_frees_slot_same_step():
+    b = ContinuousBatcher(max_sequences=2, clock=_ticker())
+    s0, s1, s2 = _seq("s0"), _seq("s1"), _seq("s2")
+    for s in (s0, s1):
+        b.offer(s)
+    for s in b.take():
+        b.join(s)
+    b.offer(s2)
+    assert b.take() == []                 # no slot yet
+    b.leave(s0)                           # finishes this step
+    assert b.free_slots == 1
+    assert [s.seq_id for s in b.take()] == ["s2"]
+    b.join(s2)
+    assert {s.seq_id for s in b.active} == {"s1", "s2"}
+
+
+def test_batcher_drain_empties_waiting_and_active():
+    b = ContinuousBatcher(max_sequences=2, clock=_ticker())
+    b.offer(_seq("s0"))
+    for s in b.take():
+        b.join(s)
+    b.offer(_seq("s1"))
+    out = b.drain()
+    assert {s.seq_id for s in out} == {"s0", "s1"}
+    assert len(b) == 0 and b.active == [] and not b.ready()
+
+
+# -- KV arena ledger ---------------------------------------------------------
+
+def test_kvcache_reserve_free_and_occupancy():
+    kv = KVCacheManager(layers=2, heads=2, head_dim=4, num_blocks=5,
+                        block_tokens=8)
+    assert kv.free_blocks == 4            # block 0 is reserved scratch
+    got = kv.try_reserve("a", 17)         # ceil(17/8) = 3 blocks
+    assert got is not None and len(got) == 3 and 0 not in got
+    assert kv.free_blocks == 1
+    assert kv.try_reserve("b", 9) is None   # needs 2, only 1 free
+    assert kv.free("a") == 3
+    assert kv.free_blocks == 4 and kv.occupancy() == 0.0
+    assert kv.free("a") == 0              # double-free is a no-op
+
+
+def test_blocks_needed_rounds_up():
+    assert blocks_needed(1, 8) == 1
+    assert blocks_needed(8, 8) == 1
+    assert blocks_needed(9, 8) == 2
+    assert blocks_needed(0, 8) == 1   # even an empty span owns one block
+
+
+# -- the lane end to end (manually stepped, no threads) ----------------------
+
+def test_greedy_decode_bit_identical_to_reference():
+    srv = Server({"lm": make_lm()}, start=False)
+    try:
+        lane = srv.enable_generate("lm", start=False)
+        prompt = [5, 9, 17, 3, 250]
+        fut = srv.submit_generate("lm", prompt, max_new_tokens=6)
+        out, = _run_lane(srv, lane, [fut])
+        assert out["finish_reason"] == "length"
+        assert out["tokens"] == _reference_greedy(srv, "lm", prompt, 6)
+    finally:
+        srv.close()
+
+
+def test_interleaved_sequences_match_solo_runs():
+    """Continuous batching (join/leave mid-flight) must not perturb any
+    sequence's tokens relative to running it alone."""
+    srv = Server({"lm": make_lm()}, start=False)
+    try:
+        lane = srv.enable_generate("lm", start=False)
+        prompts = [[5, 9, 17], [1, 2, 3, 4, 5, 6, 7], [200, 100]]
+        futs = [srv.submit_generate("lm", p, max_new_tokens=4 + i)
+                for i, p in enumerate(prompts)]
+        outs = _run_lane(srv, lane, futs)
+        for i, (p, out) in enumerate(zip(prompts, outs)):
+            assert out["tokens"] == _reference_greedy(srv, "lm", p, 4 + i)
+    finally:
+        srv.close()
+
+
+def test_blocks_freed_when_sequence_finishes():
+    srv = Server({"lm": make_lm()}, start=False)
+    try:
+        lane = srv.enable_generate("lm", start=False)
+        kv = lane.gen.kv
+        idle = kv.free_blocks
+        futs = [srv.submit_generate("lm", [5, 9, 17], max_new_tokens=3)
+                for _ in range(2)]
+        lane.step()                       # prefill: blocks leased
+        assert kv.free_blocks < idle
+        _run_lane(srv, lane, futs)
+        assert kv.free_blocks == idle     # every lease returned on finish
+        assert kv.stats()["sequences"] == 0
+    finally:
+        srv.close()
+
+
+def test_sheds_retryable_when_arena_full():
+    # ~6 blocks of 8 tokens: one 25-token span (4 blocks) fits, two don't
+    config.set("generate.arena_mb", 0.05)
+    srv = Server({"lm": make_lm()}, start=False)
+    try:
+        lane = srv.enable_generate("lm", start=False)
+        assert lane.gen.kv.free_blocks == 5
+        f0 = srv.submit_generate("lm", [5] * 5, max_new_tokens=20)
+        with pytest.raises(ServerOverloaded) as ei:
+            srv.submit_generate("lm", [7] * 5, max_new_tokens=20)
+        assert getattr(ei.value, "retryable", False)
+        _run_lane(srv, lane, [f0])        # survivor unaffected by the shed
+        # blocks are back: the same ask is admitted now
+        f1 = srv.submit_generate("lm", [7] * 5, max_new_tokens=2)
+        _run_lane(srv, lane, [f1])
+    finally:
+        srv.close()
+
+
+def test_one_compile_per_bucket_then_steady_state():
+    srv = Server({"lm": make_lm()}, start=False)
+    try:
+        lane = srv.enable_generate("lm", start=False)
+        entry = lane.gen.entry
+        f0 = srv.submit_generate("lm", [5, 9, 17], max_new_tokens=3)
+        _run_lane(srv, lane, [f0])
+        after_first = entry.compile_count + entry.cache_hits
+        assert after_first >= 2           # >=1 prefill + >=1 decode bucket
+        # same prompt bucket + same batch bucket: zero new programs
+        futs = [srv.submit_generate("lm", [8, 8, 8], max_new_tokens=3)]
+        _run_lane(srv, lane, futs)
+        assert entry.compile_count + entry.cache_hits == after_first
+    finally:
+        srv.close()
+
+
+def test_warm_restart_pays_zero_compiles(tmp_path):
+    config.set("runtime.compile_cache_dir", str(tmp_path))
+
+    def run():
+        srv = Server({"lm": make_lm()}, start=False)
+        try:
+            lane = srv.enable_generate("lm", start=False)
+            f = srv.submit_generate("lm", [5, 9, 17], max_new_tokens=4)
+            out, = _run_lane(srv, lane, [f])
+            return (out["tokens"], lane.gen.entry.compile_count,
+                    lane.gen.entry.cache_hits)
+        finally:
+            srv.close()
+
+    toks_cold, compiles_cold, _ = run()     # populates the on-disk cache
+    toks_warm, compiles_warm, hits_warm = run()
+    assert compiles_cold >= 2
+    assert compiles_warm == 0               # the restart loads, never builds
+    assert hits_warm >= compiles_cold
+    assert toks_warm == toks_cold
+
+
+# -- sampling ----------------------------------------------------------------
+
+def test_sample_token_seeded_and_deterministic():
+    logits = np.array([0.1, 2.0, 0.3, 1.9], np.float32)
+    greedy = sample_token(logits, temperature=0.0, top_k=0, seed=7,
+                          position=0)
+    assert greedy == 1
+    a = [sample_token(logits, temperature=0.8, top_k=2, seed=7, position=p)
+         for p in range(16)]
+    b = [sample_token(logits, temperature=0.8, top_k=2, seed=7, position=p)
+         for p in range(16)]
+    assert a == b                         # (seed, position) fully determine
+    assert set(a) <= {1, 3}               # top-2 of the logits
+    c = [sample_token(logits, temperature=0.8, top_k=2, seed=8, position=p)
+         for p in range(16)]
+    assert a != c                         # a different seed moves the draw
+
+
+def test_parse_prefill_buckets_defaults_and_explicit():
+    assert parse_prefill_buckets("8,32,64", 64, 8) == (8, 32, 64)
+    ladder = parse_prefill_buckets("", 64, 16)
+    assert ladder[-1] == 64 and all(b2 > b1 for b1, b2 in
+                                    zip(ladder, ladder[1:]))
+    with pytest.raises(ValueError):
+        parse_prefill_buckets("0,8", 64, 8)      # buckets must be >= 1
+    with pytest.raises(ValueError):
+        parse_prefill_buckets("8,32", 64, 8)     # ladder must cover max

@@ -392,3 +392,62 @@ def test_compile_rule_marker_and_unrelated_compiles():
         def sqlish(query):
             return query.compile()
     """), filename="mmlspark_tpu/serve/server.py") == []
+
+
+# -- rule 10: device allocations in serve/ -----------------------------------
+
+def test_flags_device_allocs_in_serve():
+    src = textwrap.dedent("""
+        import jax
+        import jax.numpy as jnp
+
+        def arena(n):
+            return jnp.zeros((n, 16), jnp.float32)
+
+        def pad(x):
+            return jnp.full_like(x, -1)
+
+        def pin(x):
+            return jax.device_put(x)
+
+        def unaliased(n):
+            return jax.numpy.empty((n,))
+    """)
+    probs = lint.check_source(
+        src, filename="mmlspark_tpu/serve/generate.py")
+    assert len(probs) == 4
+    assert all("device allocation" in p for p in probs)
+    assert "allow-alloc" in probs[0]            # the escape hatch is named
+    assert "kvcache" in probs[0]                # and the sanctioned home
+
+
+def test_alloc_rule_scoped_to_serve_and_home_exempt():
+    src = textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def arena(n):
+            return jnp.zeros((n, 16), jnp.float32)
+    """)
+    # the KV cache manager IS the arena accountant: its alloc is the point
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/serve/kvcache.py") == []
+    # outside serve/ the rule does not apply (trainers and models
+    # legitimately build device arrays)
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/parallel/trainer.py") == []
+
+
+def test_alloc_rule_marker_and_host_allocs():
+    assert lint.check_source(textwrap.dedent("""
+        import numpy as np
+        import jax.numpy as jnp
+
+        def scratch(n):
+            return jnp.zeros((n,))  # lint: allow-alloc
+
+        def host_side(n):
+            return np.zeros((n, 16), np.float32)
+
+        def also_host(x):
+            return np.full_like(x, -1)
+    """), filename="mmlspark_tpu/serve/server.py") == []
