@@ -1353,13 +1353,11 @@ def config_serving() -> dict:
     finally:
         server.close()
     t_fw = _best(rounds, 0)
+    from mmlspark_tpu.observability.metrics import nearest_rank
     srt = sorted(lats)
 
     def pct(p: float) -> float:
-        if not srt:
-            return 0.0
-        return srt[min(len(srt) - 1,
-                       int(round(p / 100.0 * (len(srt) - 1))))] * 1e3
+        return nearest_rank(srt, p) * 1e3
 
     return {"value": round(n / t_fw, 2), "unit": "requests/sec/chip",
             "vs_baseline": _scaled_ratio(rounds, 1, 0, n, nb_base),
@@ -1380,7 +1378,13 @@ def config_serving_fleet() -> dict:
     resilience facts the chaos harness asserts (zero failed requests,
     failovers observed). ``kill_degradation`` is steady/killed
     throughput — the price of losing a third of the fleet mid-run, which
-    the regression gate tracks once a baseline records it."""
+    the regression gate tracks once a baseline records it.
+
+    Informational (never gated): ``scrape_ms`` — one FleetScraper sweep
+    over the live fleet — and ``steady_rps_scraper_on`` /
+    ``scraper_overhead``, the same steady workload with the background
+    scraper polling at 50 ms, i.e. what turning the observability plane
+    on costs the serving plane."""
     import threading as _threading
     from mmlspark_tpu.models.jax_model import JaxModel
     from mmlspark_tpu.reliability.retry import RetryPolicy
@@ -1402,12 +1406,15 @@ def config_serving_fleet() -> dict:
     # kills) and the first replica's first-score latency (compile_ms)
     cold_box: list = [None, None]
 
-    def run_pass(kill: bool):
+    def run_pass(kill: bool, scrape: bool = False):
+        from mmlspark_tpu.observability.aggregate import FleetScraper
         t_cold = time.perf_counter()
         fleet = Fleet({"mlp": jm}, replicas=replicas,
                       server_kwargs=dict(max_batch=bs, max_wait_ms=1.0,
                                          queue_depth=4 * n,
                                          buckets=(1, 8, bs)))
+        scraper = FleetScraper(fleet) if scrape else None
+        scrape_ms = None
         lats: list = []
         errs: list = []
         done = _threading.Event()
@@ -1447,6 +1454,15 @@ def config_serving_fleet() -> dict:
             if kill:
                 kt = _threading.Thread(target=killer, daemon=True)
                 kt.start()
+            if scraper is not None:
+                # one-sweep cost against the warm fleet, then leave the
+                # background poller running through the timed region
+                t_s = time.perf_counter()
+                for _ in range(20):
+                    scraper.scrape()
+                scrape_ms = round(
+                    (time.perf_counter() - t_s) / 20 * 1e3, 3)
+                scraper.start(interval_s=0.05)
             t0 = time.perf_counter()
             threads = [_threading.Thread(target=client,
                                          args=(range(c, n, clients),),
@@ -1460,12 +1476,14 @@ def config_serving_fleet() -> dict:
             done.set()
             if kt is not None:
                 kt.join()
+            if scraper is not None:
+                scraper.stop()
             stats = fleet.stats()
         finally:
             fleet.close()
         if errs:
             raise errs[0]
-        return elapsed, sorted(lats), stats
+        return elapsed, sorted(lats), stats, scrape_ms
 
     def run_single() -> float:
         # baseline: the same closed-loop workload against ONE plain
@@ -1494,16 +1512,16 @@ def config_serving_fleet() -> dict:
         finally:
             srv.close()
 
+    from mmlspark_tpu.observability.metrics import nearest_rank
+
     def pct(srt: list, p: float) -> float:
-        if not srt:
-            return 0.0
-        return srt[min(len(srt) - 1,
-                       int(round(p / 100.0 * (len(srt) - 1))))] * 1e3
+        return nearest_rank(srt, p) * 1e3
 
     run_pass(kill=False)   # process warmup (thread pools, shared jit)
     t_single = run_single()
-    t_steady, lat_s, _ = run_pass(kill=False)
-    t_killed, lat_k, stats_k = run_pass(kill=True)
+    t_steady, lat_s, _, _ = run_pass(kill=False)
+    t_scraped, _, _, scrape_ms = run_pass(kill=False, scrape=True)
+    t_killed, lat_k, stats_k, _ = run_pass(kill=True)
     shed = sum(int(s.get("shed", 0)) for s in stats_k["servers"].values())
     return {"value": round(n / t_steady, 2), "unit": "requests/sec/chip",
             "vs_baseline": round(t_single / t_steady, 4),
@@ -1515,6 +1533,9 @@ def config_serving_fleet() -> dict:
             "kill_degradation": round(t_killed / t_steady, 4),
             "failovers": int(stats_k["failovers"]), "shed": shed,
             "replicas": replicas, "served_after_kill": len(lat_k),
+            "scrape_ms": scrape_ms,
+            "steady_rps_scraper_on": round(n / t_scraped, 2),
+            "scraper_overhead": round(t_scraped / t_steady, 4),
             "compile_ms": cold_box[1], "cold_start_ms": cold_box[0]}
 
 
@@ -1632,13 +1653,11 @@ def config_decode() -> dict:
             mmlconfig.set(k, v)
     t_fw = _best(rounds, 0)
     tokens = total_reqs * max_new
+    from mmlspark_tpu.observability.metrics import nearest_rank
     srt = sorted(ttfts)
 
     def pct(p: float) -> float:
-        if not srt:
-            return 0.0
-        return srt[min(len(srt) - 1,
-                       int(round(p / 100.0 * (len(srt) - 1))))]
+        return nearest_rank(srt, p)
 
     return {"value": round(tokens / t_fw, 2), "unit": "tokens/sec/chip",
             "vs_baseline": round(_med_ratio(rounds, 1, 0), 4),

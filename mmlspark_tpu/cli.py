@@ -269,22 +269,52 @@ def cmd_check(args, passthrough) -> int:
 
 
 def cmd_report(args, passthrough) -> int:
-    """Render a run report from a telemetry event log (JSONL); --json for
+    """Render a run report from one or more telemetry event logs (JSONL,
+    per-pid sidecars merge natively; --glob adds a pattern); --json for
     the structured form, --trace to also export a Chrome-trace/Perfetto
     timeline of the same log."""
+    from mmlspark_tpu.observability.aggregate import expand_event_paths
+    paths = expand_event_paths(args.events, getattr(args, "glob", "")
+                               or None)
+    if not paths:
+        raise SystemExit("report: no event logs matched")
+    target = paths[0] if len(paths) == 1 else paths
     if getattr(args, "trace", None):
+        if len(paths) > 1:
+            raise SystemExit(
+                "--trace exports one log at a time; pass a single events "
+                "path")
         from mmlspark_tpu.observability.trace import export_trace
-        stats = export_trace(args.events, args.trace)
+        stats = export_trace(paths[0], args.trace)
         print(f"trace: {stats['out']} ({stats['spans']} spans, "  # lint: allow-print
               f"{stats['events']} events, {stats['tracks']} tracks) — "
               "open in https://ui.perfetto.dev")
     if getattr(args, "json", False):
         from mmlspark_tpu.observability.report import build_report
-        print(json.dumps(build_report(args.events, top=args.top),  # lint: allow-print
+        print(json.dumps(build_report(target, top=args.top),  # lint: allow-print
                          sort_keys=True))
     else:
         from mmlspark_tpu.observability.report import render_report
-        print(render_report(args.events, top=args.top))  # lint: allow-print
+        print(render_report(target, top=args.top))  # lint: allow-print
+    return 0
+
+
+def cmd_top(args, passthrough) -> int:
+    """Live fleet dashboard over HTTP replicas: scrapes ``/metrics`` +
+    ``/readyz`` from every --replica through per-host circuit breakers
+    and redraws a plain-ANSI frame (per-replica readiness, queue depth,
+    QPS, p50/p99, shed, SLO burn, HBM occupancy). ``--once`` prints a
+    single frame and exits (tests/CI)."""
+    from mmlspark_tpu.observability.aggregate import FleetScraper
+    from mmlspark_tpu.observability.dashboard import TopDashboard
+    from mmlspark_tpu.observability.slo import SloEngine
+    from mmlspark_tpu.serve.router import HttpReplica
+    if not args.replica:
+        raise SystemExit("top: at least one --replica HOST:PORT required")
+    replicas = [HttpReplica(addr) for addr in args.replica]
+    scraper = FleetScraper(replicas, timeout_s=args.timeout)
+    dash = TopDashboard(scraper, SloEngine(), interval_s=args.interval)
+    dash.run(once=args.once)
     return 0
 
 
@@ -343,14 +373,22 @@ def cmd_serve(args, passthrough) -> int:
     server_kwargs = dict(max_batch=args.max_batch,
                          max_wait_ms=args.max_wait_ms,
                          queue_depth=args.queue_depth, buckets=buckets)
+    from mmlspark_tpu.observability import memory as devmem
+    devmem.start_audit_poller()  # no-op unless observability.memory_poll_s
     fleet = None
+    scraper = None
     if args.replicas > 1:
         # fleet mode: N in-process replicas behind the health-checked
         # router (failover, fairness, rolling rollout; docs/SERVING.md)
+        from mmlspark_tpu.observability.aggregate import FleetScraper
         from mmlspark_tpu.serve.fleet import Fleet
         fleet = Fleet(models, replicas=args.replicas,
                       server_kwargs=server_kwargs)
         fleet.router.start_prober()
+        # background fleet scrape keeps the aggregated per-replica view
+        # (and the HBM ledger gauges) warm for `mmlspark-tpu top`
+        scraper = FleetScraper(fleet)
+        scraper.start()
         front = fleet.router
     else:
         server = Server(models, **server_kwargs)
@@ -390,12 +428,15 @@ def cmd_serve(args, passthrough) -> int:
         pass  # clean Ctrl-C shutdown path (no handler installed off-main)
     finally:
         httpd.server_close()
+        if scraper is not None:
+            scraper.stop()
         if fleet is not None:
             fleet.close()
         else:
             server.close()
         if watchdog is not None:
             watchdog.close()
+        devmem.stop_audit_poller()
     return 0
 
 
@@ -575,9 +616,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     chaos_p.set_defaults(fn=cmd_chaos)
 
     report_p = sub.add_parser(
-        "report", help="render a run report from a telemetry event log")
-    report_p.add_argument("events", help="path to an events.jsonl written "
-                          "with observability.events_path set")
+        "report", help="render a run report from telemetry event log(s)")
+    report_p.add_argument("events", nargs="*",
+                          help="path(s) to events.jsonl written with "
+                          "observability.events_path set; per-pid "
+                          "sidecars merge (inline globs OK; may be "
+                          "omitted when --glob is given)")
+    report_p.add_argument("--glob", default="",
+                          help="additionally merge every log matching "
+                          "this glob (e.g. 'run1/events-*.jsonl')")
     report_p.add_argument("--top", type=int, default=10,
                           help="rows in the slowest-span table (default 10)")
     report_p.add_argument("--trace", default="",
@@ -587,6 +634,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                           help="emit the structured report as one JSON "
                           "object instead of text")
     report_p.set_defaults(fn=cmd_report)
+
+    top_p = sub.add_parser(
+        "top", help="live fleet dashboard (scrapes /metrics + /readyz)")
+    top_p.add_argument("--replica", action="append", default=[],
+                       metavar="HOST:PORT",
+                       help="replica address to scrape (repeatable)")
+    top_p.add_argument("--interval", type=float, default=2.0,
+                       help="redraw interval in seconds (default 2)")
+    top_p.add_argument("--once", action="store_true",
+                       help="print one frame and exit (tests/CI)")
+    top_p.add_argument("--timeout", type=float, default=2.0,
+                       help="per-replica scrape timeout in seconds")
+    top_p.set_defaults(fn=cmd_top)
 
     args = parser.parse_args(argv)
     try:

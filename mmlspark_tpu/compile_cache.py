@@ -198,7 +198,22 @@ def _load_entry(path: str, fingerprint: str) -> CacheResult | None:
                reason=f"{type(e).__name__}: {e}")
         _quarantine(path)
         return None
+    _charge_program(header.get("model"), path, len(body))
     return CacheResult(program, "hit")
+
+
+def _charge_program(model: Any, path: str, nbytes: int) -> None:
+    """Report one loaded/stored executable's serialized size into the HBM
+    ledger (kind=``program``, keyed by cache path so reloads never
+    double-charge). Best-effort: accounting must never fail a compile."""
+    if not model:
+        return
+    try:
+        from mmlspark_tpu.observability import memory as devmem
+        devmem.get_ledger().note_program(str(model), path, int(nbytes))
+    except Exception as e:  # pragma: no cover - defensive
+        logger.warning("program-bytes ledger charge failed for %s (%s)",
+                       path, e)
 
 
 def _store_entry(path: str, program, meta: Dict[str, Any],
@@ -232,6 +247,7 @@ def _store_entry(path: str, program, meta: Dict[str, Any],
             pass
         return False
     _counter("stores").inc()
+    _charge_program(meta.get("model"), path, len(body))
     _event("store", path=path, bytes=len(body), **meta)
     return True
 

@@ -23,7 +23,15 @@ wall-clock timing). The pieces, one pipeline:
 - :mod:`trace` — Chrome-trace/Perfetto export of a captured log
   (``mmlspark-tpu report ... --trace out.trace.json``);
 - :mod:`benchgate` — the bench regression gate
-  (``mmlspark-tpu bench --baseline BENCH_rNN.json``).
+  (``mmlspark-tpu bench --baseline BENCH_rNN.json``);
+- :mod:`aggregate` — the fleet scraper: per-replica ``/metrics`` +
+  ``/readyz`` merged into one ``replica=``-labeled registry, plus
+  multi-process event-log merging for the report;
+- :mod:`slo` — declarative ``slo.*`` objectives with fast/slow-window
+  burn-rate alerting (``slo.burn``/``slo.breach`` events);
+- :mod:`memory` — the unified HBM ledger (bytes by ``{model, kind}``,
+  high-watermark, ``memory.pressure`` events, live-array audit);
+- :mod:`dashboard` — ``mmlspark-tpu top``, the live fleet view.
 
 Everything is near-zero-cost when disabled: ``span()`` short-circuits to
 a shared no-op before any string work, ``emit()`` returns before
@@ -55,3 +63,15 @@ from mmlspark_tpu.observability.metrics import (  # noqa: F401
 )
 from mmlspark_tpu.observability.spans import span  # noqa: F401
 from mmlspark_tpu.observability.syncs import sync_point  # noqa: F401
+from mmlspark_tpu.observability.aggregate import (  # noqa: F401
+    AggregatedRegistry,
+    FleetScraper,
+    merge_event_logs,
+)
+from mmlspark_tpu.observability.memory import (  # noqa: F401
+    MemoryLedger,
+    audit_device_bytes,
+    get_ledger,
+)
+from mmlspark_tpu.observability.slo import Objective, SloEngine  # noqa: F401
+from mmlspark_tpu.observability.dashboard import TopDashboard  # noqa: F401

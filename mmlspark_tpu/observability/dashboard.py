@@ -1,0 +1,172 @@
+"""``mmlspark-tpu top``: the operator's one-glance fleet view.
+
+A live terminal dashboard over :class:`FleetScraper` + :class:`SloEngine`
+— per-replica ready/draining, queue depth, QPS, p50/p99, shed rate, SLO
+burn, HBM occupancy — for watching a ``Fleet.rollout`` or a chaos run in
+real time. Deliberately curses-free: each frame is a plain string and
+the live loop just re-homes the cursor with ANSI ``ESC[H ESC[J`` before
+printing, so it works over ssh, inside tmux, and in CI logs alike.
+``--once`` (the :meth:`TopDashboard.run` ``once`` flag) prints a single
+frame and exits — the form tests and scripts use. Clock and output
+stream are injectable.
+
+Rates (QPS, shed rate) are derived from the delta between consecutive
+scrapes, so the first frame shows totals only.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time as _time
+from typing import Any, Callable, Dict, List, Optional
+
+from mmlspark_tpu.observability import events
+from mmlspark_tpu.observability.aggregate import FleetScraper
+from mmlspark_tpu.observability.slo import SloEngine
+
+_CLEAR = "\x1b[H\x1b[J"
+
+
+def format_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1000.0 or unit == "GB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1000.0
+    return f"{n:.1f}GB"  # pragma: no cover - loop always returns
+
+
+def _rate(cur: float, prev: Optional[float], dt: float) -> Optional[float]:
+    if prev is None or dt <= 0 or cur < prev:
+        return None
+    return (cur - prev) / dt
+
+
+class TopDashboard:
+    """Render loop over one scraper (and optionally one SLO engine).
+
+    ``tick()`` = one scrape -> one SLO evaluation -> one frame string;
+    ``run(once=True)`` prints a single frame, ``run()`` redraws every
+    ``interval_s`` until ``stop()`` / KeyboardInterrupt.
+    """
+
+    def __init__(self, scraper: FleetScraper,
+                 engine: Optional[SloEngine] = None, *,
+                 clock: Optional[Callable[[], float]] = None,
+                 out=None, interval_s: float = 2.0):
+        self.scraper = scraper
+        self.engine = engine
+        self.clock = clock or events.wall
+        self.out = out if out is not None else sys.stdout
+        self.interval_s = float(interval_s)
+        self._prev: Optional[Dict[str, Any]] = None
+        self._prev_t: Optional[float] = None
+        self._stop = threading.Event()
+
+    # -- one frame ---------------------------------------------------------
+    def tick(self) -> str:
+        snap = self.scraper.scrape()
+        status = None
+        if self.engine is not None:
+            status = self.engine.observe(self.scraper.slo_sample(snap))
+        frame = self.render(snap, status)
+        self._prev = snap
+        self._prev_t = float(snap["ts"])
+        return frame
+
+    def render(self, snap: Dict[str, Any],
+               slo_status: Optional[List[Dict[str, Any]]] = None) -> str:
+        now = float(snap["ts"])
+        dt = (now - self._prev_t) if self._prev_t is not None else 0.0
+        prev_fleet = (self._prev or {}).get("fleet", {})
+        fleet = snap.get("fleet", {})
+        reps = snap.get("replicas", {})
+        ready = sum(1 for r in reps.values() if r.get("ready"))
+        lines = [
+            f"mmlspark-tpu top  t={now:.1f}  replicas {ready}/{len(reps)} "
+            f"ready  scrape {snap.get('scrape_ms', 0.0):.1f}ms"]
+
+        qps = _rate(fleet.get("admitted", 0.0),
+                    prev_fleet.get("admitted"), dt)
+        shed_rate = _rate(fleet.get("shed", 0.0), prev_fleet.get("shed"), dt)
+        parts = [f"admitted {fleet.get('admitted', 0.0):.0f}",
+                 f"shed {fleet.get('shed', 0.0):.0f}",
+                 f"expired {fleet.get('expired', 0.0):.0f}",
+                 f"failovers {fleet.get('failovers', 0.0):.0f}",
+                 f"p50 {fleet.get('p50_ms', 0.0):.1f}ms",
+                 f"p99 {fleet.get('p99_ms', 0.0):.1f}ms"]
+        if qps is not None:
+            parts.insert(0, f"qps {qps:.1f}")
+        if shed_rate is not None:
+            parts.append(f"shed/s {shed_rate:.1f}")
+        lines.append("fleet    " + "  ".join(parts))
+
+        for st in slo_status or []:
+            flag = "BREACH" if st["breaching"] else (
+                "burn" if st["burning"] else "ok")
+            lines.append(
+                f"slo      {st['objective']:<14} fast {st['burn_fast']:>7.2f}"
+                f"  slow {st['burn_slow']:>7.2f}  [{flag}]")
+
+        mem = snap.get("memory", {})
+        kinds = mem.get("by_kind", {})
+        lines.append(
+            "hbm      total " + format_bytes(mem.get("total_bytes", 0))
+            + "  hwm " + format_bytes(mem.get("high_watermark_bytes", 0))
+            + "".join(f"  {k} {format_bytes(v)}"
+                      for k, v in sorted(kinds.items())))
+        for model, mk in sorted(mem.get("by_model", {}).items()):
+            lines.append(
+                f"         {model}: "
+                + "  ".join(f"{k} {format_bytes(v)}"
+                            for k, v in sorted(mk.items())))
+
+        name_w = max([10] + [len(n) + 2 for n in reps])
+        header = (f"{'replica':<{name_w}}{'state':<10}{'ready':<7}{'queue':<7}"
+                  f"{'inflight':<10}{'admitted':<10}{'shed':<7}"
+                  f"{'p50ms':<9}{'p99ms':<9}{'breaker':<10}")
+        lines.append(header)
+        lines.append("-" * len(header))
+        prev_reps = (self._prev or {}).get("replicas", {})
+        for name, r in sorted(reps.items()):
+            s = r.get("stats", {})
+            prev_s = prev_reps.get(name, {}).get("stats", {})
+            rqps = _rate(s.get("admitted", 0.0),
+                         prev_s.get("admitted"), dt)
+            admitted = (f"{rqps:.1f}/s" if rqps is not None
+                        else f"{s.get('admitted', 0.0):.0f}")
+            err = r.get("error")
+            state = r.get("state", "?") if not err else err[:18]
+            lines.append(
+                f"{name:<{name_w}}{state:<10}"
+                f"{'yes' if r.get('ready') else 'NO':<7}"
+                f"{s.get('queue_depth', 0.0):<7.0f}"
+                f"{s.get('inflight', 0.0):<10.0f}"
+                f"{admitted:<10}"
+                f"{s.get('shed', 0.0):<7.0f}"
+                f"{s.get('p50_ms', 0.0):<9.2f}"
+                f"{s.get('p99_ms', 0.0):<9.2f}"
+                f"{r.get('breaker', '?'):<10}")
+        return "\n".join(lines) + "\n"
+
+    # -- loop --------------------------------------------------------------
+    def run(self, once: bool = False,
+            sleep: Optional[Callable[[float], None]] = None) -> None:
+        """Print frames until stopped. ``once=True`` prints exactly one
+        frame with no ANSI clear (CI/test friendly)."""
+        if once:
+            self.out.write(self.tick())
+            self.out.flush()
+            return
+        sleep = sleep or _time.sleep
+        try:
+            while not self._stop.is_set():
+                frame = self.tick()
+                self.out.write(_CLEAR + frame)
+                self.out.flush()
+                sleep(self.interval_s)
+        except KeyboardInterrupt:  # pragma: no cover - interactive only
+            pass
+
+    def stop(self) -> None:
+        self._stop.set()

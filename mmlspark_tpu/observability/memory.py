@@ -1,0 +1,242 @@
+"""Unified HBM ledger: one accounting home for device bytes.
+
+Residency accounting used to be scattered: the serving registry summed
+param bytes, the KV arena computed its own footprint, the AOT program
+cache knew serialized executable sizes, and nobody added them up. This
+module is the single place device-byte arithmetic is allowed to live
+(lint Rule 11 flags ``nbytes``/``itemsize`` arithmetic in ``serve/``
+outside this home) and the single place totals are kept:
+
+- :func:`nbytes_of` / :func:`param_bytes` — the shared size arithmetic
+  the registry and KV arena delegate to;
+- :class:`MemoryLedger` — bytes by ``{model, kind in params|kv|program}``
+  with a process high-watermark, published as ``memory.*`` gauges and
+  exported per-``{model,kind}`` as labeled series by the fleet scraper;
+- ``memory.pressure`` events emitted when the registry LRU evicts a
+  warm model (they land in the flight recorder, so an OOM post-mortem
+  shows WHO was evicted to make room);
+- :func:`audit_device_bytes` — an optional ``jax.live_arrays()`` sweep
+  that compares actually-live device bytes against the ledger and flags
+  the unaccounted remainder (leaked intermediates, untracked caches).
+
+``program`` bytes are the serialized executable size reported by the
+persistent compile cache — a proxy for the program's HBM footprint,
+known only when ``runtime.compile_cache_dir`` is active (in-memory
+bypass compiles are not charged).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.observability import events, metrics
+from mmlspark_tpu.utils import config as mmlconfig
+
+KINDS = ("params", "kv", "program")
+
+
+def nbytes_of(shape: Sequence[int], dtype: Any) -> int:
+    """Bytes of one dense array of ``shape``/``dtype`` — THE size
+    arithmetic everything in serve/ delegates to."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * np.dtype(dtype).itemsize
+
+
+def param_bytes(params: Any) -> int:
+    """Summed bytes of every array leaf in a param tree (0 for None)."""
+    if params is None:
+        return 0
+    import jax
+    return sum(nbytes_of(l.shape, l.dtype)
+               for l in jax.tree_util.tree_leaves(params)
+               if hasattr(l, "shape") and hasattr(l, "dtype"))
+
+
+class MemoryLedger:
+    """Process-wide bytes-by-``{model, kind}`` map with a high-watermark.
+
+    ``params`` and ``kv`` are *set* (the registry re-syncs them after
+    every warm/evict, so the ledger mirrors the current warm set);
+    ``program`` entries are keyed by the compiled artifact's cache path
+    so re-loading the same executable never double-charges.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._bytes: Dict[Tuple[str, str], int] = {}
+        self._programs: Dict[str, Dict[str, int]] = {}
+        self._hwm = 0
+
+    # -- writes ------------------------------------------------------------
+    def set_bytes(self, model: str, kind: str, nbytes: int) -> None:
+        if kind not in KINDS:
+            raise ValueError(f"kind must be one of {KINDS}, got {kind!r}")
+        with self._lock:
+            if nbytes <= 0:
+                self._bytes.pop((str(model), kind), None)
+            else:
+                self._bytes[(str(model), kind)] = int(nbytes)
+        self._publish()
+
+    def note_program(self, model: str, key: str, nbytes: int) -> None:
+        """Charge one compiled program (idempotent per ``key``)."""
+        with self._lock:
+            progs = self._programs.setdefault(str(model), {})
+            progs[str(key)] = int(nbytes)
+            self._bytes[(str(model), "program")] = sum(progs.values())
+        self._publish()
+
+    def clear(self, model: Optional[str] = None,
+              kind: Optional[str] = None) -> None:
+        with self._lock:
+            if model is None and kind is None:
+                self._bytes.clear()
+                self._programs.clear()
+            else:
+                for k in list(self._bytes):
+                    if ((model is None or k[0] == str(model))
+                            and (kind is None or k[1] == kind)):
+                        del self._bytes[k]
+                if kind in (None, "program"):
+                    if model is None:
+                        self._programs.clear()
+                    else:
+                        self._programs.pop(str(model), None)
+        self._publish()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._bytes.clear()
+            self._programs.clear()
+            self._hwm = 0
+        self._publish()
+
+    # -- reads -------------------------------------------------------------
+    def total(self, model: Optional[str] = None,
+              kind: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(v for k, v in self._bytes.items()
+                       if (model is None or k[0] == str(model))
+                       and (kind is None or k[1] == kind))
+
+    @property
+    def high_watermark(self) -> int:
+        return self._hwm
+
+    def snapshot(self) -> Dict[str, Any]:
+        """``{total_bytes, high_watermark_bytes, by_kind, by_model}`` —
+        the shape the scraper turns into labeled series and ``top``
+        renders as the HBM panel."""
+        with self._lock:
+            by_model: Dict[str, Dict[str, int]] = {}
+            by_kind = {k: 0 for k in KINDS}
+            for (model, kind), v in sorted(self._bytes.items()):
+                by_model.setdefault(model, {})[kind] = v
+                by_kind[kind] += v
+            total = sum(self._bytes.values())
+            return {"total_bytes": total,
+                    "high_watermark_bytes": self._hwm,
+                    "by_kind": by_kind,
+                    "by_model": by_model}
+
+    # -- eviction pressure -------------------------------------------------
+    def on_eviction(self, model: str, freed_bytes: int, *,
+                    resident_bytes: int, budget_bytes: float,
+                    reason: str = "lru") -> None:
+        """Called by the registry LRU when it evicts a warm model: clear
+        the victim's ledger lines and emit a ``memory.pressure`` event
+        (flight-recorder visible) plus a counter."""
+        self.clear(model)
+        metrics.counter("memory.pressure").inc()
+        if events.recording_enabled():
+            events.emit("memory", "pressure", model=str(model),
+                        reason=reason, freed_bytes=int(freed_bytes),
+                        resident_bytes=int(resident_bytes),
+                        budget_bytes=float(budget_bytes))
+
+    # -- internal ----------------------------------------------------------
+    def _publish(self) -> None:
+        with self._lock:
+            by_kind = {k: 0 for k in KINDS}
+            for (_, kind), v in self._bytes.items():
+                by_kind[kind] += v
+            total = sum(self._bytes.values())
+            if total > self._hwm:
+                self._hwm = total
+            hwm = self._hwm
+        metrics.gauge("memory.hbm_bytes").set(total)
+        metrics.gauge("memory.hbm_high_watermark_bytes").set(hwm)
+        for kind, v in by_kind.items():
+            metrics.gauge(f"memory.bytes.{kind}").set(v)
+
+
+_LEDGER = MemoryLedger()
+
+
+def get_ledger() -> MemoryLedger:
+    """The process-wide ledger every accounting site reports into."""
+    return _LEDGER
+
+
+def audit_device_bytes(ledger: Optional[MemoryLedger] = None
+                       ) -> Dict[str, Any]:
+    """Compare actually-live device bytes (``jax.live_arrays()``) against
+    the ledger. ``unaccounted_bytes`` > 0 means device memory the ledger
+    does not know about (leaked intermediates, untracked caches); the
+    result is advisory — committed-vs-live can legitimately diverge
+    (donated buffers, as-yet-uncollected garbage)."""
+    ledger = ledger or get_ledger()
+    accounted = ledger.total()
+    try:
+        import jax
+        live = sum(int(getattr(a, "nbytes", 0)) for a in jax.live_arrays())
+        arrays = len(jax.live_arrays())
+    except Exception as e:  # platforms without live_arrays support
+        return {"supported": False, "error": f"{type(e).__name__}: {e}",
+                "accounted_bytes": accounted}
+    unaccounted = max(0, live - accounted)
+    out = {"supported": True, "live_bytes": live, "live_arrays": arrays,
+           "accounted_bytes": accounted, "unaccounted_bytes": unaccounted}
+    metrics.gauge("memory.unaccounted_bytes").set(unaccounted)
+    if events.recording_enabled():
+        events.emit("memory", "audit", **out)
+    return out
+
+
+_POLLER: Dict[str, Any] = {"thread": None, "stop": None}
+
+
+def start_audit_poller(interval_s: Optional[float] = None) -> bool:
+    """Run :func:`audit_device_bytes` on a daemon thread every
+    ``observability.memory_poll_s`` seconds (<= 0 = disabled, no thread).
+    Idempotent; returns True when a poller is running."""
+    interval = float(interval_s if interval_s is not None
+                     else mmlconfig.get("observability.memory_poll_s"))
+    if _POLLER["thread"] is not None:
+        return True
+    if interval <= 0:
+        return False
+    stop = threading.Event()
+
+    def loop() -> None:
+        while not stop.wait(interval):
+            audit_device_bytes()
+
+    t = threading.Thread(target=loop, name="mmlspark-tpu-memaudit",
+                         daemon=True)
+    _POLLER["thread"], _POLLER["stop"] = t, stop
+    t.start()
+    return True
+
+
+def stop_audit_poller() -> None:
+    t, stop = _POLLER["thread"], _POLLER["stop"]
+    if t is None:
+        return
+    stop.set()
+    t.join(timeout=5.0)
+    _POLLER["thread"] = _POLLER["stop"] = None

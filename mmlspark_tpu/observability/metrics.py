@@ -49,6 +49,56 @@ def sanitize(name: str) -> str:
     return name
 
 
+def nearest_rank(sorted_vals: Sequence[float], p: float) -> float:
+    """Nearest-rank percentile over RAW sorted samples — the one
+    implementation behind the report's and bench's client-side p50/p99
+    (histogram-backed percentiles go through
+    :func:`percentile_from_buckets` instead)."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return float(sorted_vals[i])
+
+
+def percentile_from_buckets(cumulative: Dict[Any, Any], p: float) -> float:
+    """Bucket-interpolation percentile from a cumulative ``{le: count}``
+    mapping (Prometheus ``le`` semantics, ``+Inf`` slot included) — the
+    one estimator behind :meth:`Histogram.percentile`, the serve summary,
+    the SLO engine and ``top``. ``p`` is in [0, 100].
+
+    Linear interpolation within the bucket containing the target rank;
+    a rank that lands in the ``+Inf`` overflow bucket clamps to the
+    highest finite bound (there is no upper edge to interpolate to).
+    Empty histograms return 0.0.
+    """
+    finite = []
+    inf_count: Optional[float] = None
+    for le, c in cumulative.items():
+        if isinstance(le, str) and le.strip().lstrip("+") in ("Inf", "inf"):
+            inf_count = float(c)
+        else:
+            f = float(le)
+            if f == float("inf"):
+                inf_count = float(c)
+            else:
+                finite.append((f, float(c)))
+    finite.sort()
+    total = inf_count if inf_count is not None else (
+        finite[-1][1] if finite else 0.0)
+    if total <= 0:
+        return 0.0
+    rank = max(0.0, min(100.0, float(p))) / 100.0 * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in finite:
+        if cum >= rank:
+            span = cum - prev_cum
+            frac = (rank - prev_cum) / span if span > 0 else 1.0
+            return prev_bound + (bound - prev_bound) * frac
+        prev_bound, prev_cum = bound, cum
+    return finite[-1][0] if finite else 0.0
+
+
 def escape_label_value(value: str) -> str:
     """Escape a label value per the Prometheus text exposition format:
     backslash, double-quote, and newline must be escaped inside the
@@ -143,6 +193,11 @@ class Histogram:
     @property
     def sum(self) -> float:
         return self._sum
+
+    def percentile(self, p: float) -> float:
+        """Bucket-interpolated percentile (``p`` in [0, 100]) — see
+        :func:`percentile_from_buckets` for the estimator contract."""
+        return percentile_from_buckets(self.cumulative(), p)
 
     def cumulative(self) -> Dict[str, int]:
         """``{le: cumulative count}`` including the ``+Inf`` bucket."""

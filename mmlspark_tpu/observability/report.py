@@ -51,6 +51,7 @@ import json
 from collections import defaultdict
 from typing import Any, Dict, List, Optional
 
+from mmlspark_tpu.observability import metrics
 from mmlspark_tpu.utils.logging import get_logger
 
 
@@ -75,12 +76,10 @@ def load_events(path: str) -> List[Dict[str, Any]]:
 
 
 def _pct(sorted_vals: List[float], p: float) -> float:
-    """Nearest-rank percentile of an ascending list (0 when empty)."""
-    if not sorted_vals:
-        return 0.0
-    i = min(len(sorted_vals) - 1,
-            max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
-    return sorted_vals[i]
+    """Nearest-rank percentile of an ascending list (0 when empty) —
+    delegates to the shared estimator in :mod:`..metrics` so report,
+    bench and the serve summary agree on the arithmetic."""
+    return metrics.nearest_rank(sorted_vals, p)
 
 
 def _mean(events: List[Dict[str, Any]], field: str) -> float:
@@ -97,19 +96,31 @@ def _table(rows: List[List[str]], header: List[str]) -> List[str]:
     return lines
 
 
-def build_report(path: str, top: int = 10,
+def build_report(path, top: int = 10,
                  events: Optional[List[Dict[str, Any]]] = None
                  ) -> Dict[str, Any]:
     """One structured dict with every section of the run report (the
-    ``--json`` output). Sections with nothing to say are absent."""
+    ``--json`` output). Sections with nothing to say are absent.
+
+    ``path`` may be one event-log path or a list of them (per-pid
+    sidecars from a multi-process run): multiple logs are merged into
+    one ts-ordered stream; the span sections' ``(pid, span_id)`` dedupe
+    already absorbs any overlap."""
+    paths = [path] if isinstance(path, str) else list(path)
     if events is None:
-        events = load_events(path)
+        if len(paths) == 1:
+            events = load_events(paths[0])
+        else:
+            from mmlspark_tpu.observability.aggregate import merge_event_logs
+            events = merge_event_logs(paths)
+    path = paths[0] if len(paths) == 1 else ", ".join(paths)
     spans = [e for e in events if e.get("type") == "span"]
     plain = [e for e in events if e.get("type") == "event"]
     metrics = [e for e in events if e.get("type") == "metric"]
 
     report: Dict[str, Any] = {
         "path": path,
+        "paths": paths,
         "events": len(events),
         "spans": len(spans),
         "metrics": len(metrics),
@@ -364,6 +375,51 @@ def build_report(path: str, top: int = 10,
             fl["rollouts"] = list(by_target.values())
         report["fleet"] = fl
 
+    # -- SLO burn/breach (slo.* events from the burn-rate engine) ----------
+    slo_ev = [e for e in events if e.get("type") == "slo"]
+    if slo_ev:
+        by_obj: Dict[str, Dict[str, Any]] = {}
+        for e in slo_ev:
+            o = by_obj.setdefault(
+                str(e.get("objective", "?")),
+                {"burns": 0, "breaches": 0, "recovers": 0,
+                 "max_burn_fast": 0.0})
+            name = e.get("name")
+            if name == "burn":
+                o["burns"] += 1
+            elif name == "breach":
+                o["breaches"] += 1
+            elif name == "recover":
+                o["recovers"] += 1
+            o["max_burn_fast"] = round(max(
+                o["max_burn_fast"], float(e.get("burn_fast", 0.0))), 4)
+        report["slo"] = {"events": len(slo_ev),
+                         "objectives": dict(sorted(by_obj.items()))}
+
+    # -- HBM memory (memory.pressure / memory.audit events) ----------------
+    mem_ev = [e for e in events if e.get("type") == "memory"]
+    if mem_ev:
+        pressures = [e for e in mem_ev if e.get("name") == "pressure"]
+        audits = [e for e in mem_ev if e.get("name") == "audit"]
+        mem: Dict[str, Any] = {}
+        if pressures:
+            by_model: Dict[str, int] = defaultdict(int)
+            freed = 0
+            for e in pressures:
+                by_model[str(e.get("model", "?"))] += 1
+                freed += int(e.get("freed_bytes", 0))
+            mem["pressure"] = {"count": len(pressures),
+                               "freed_bytes": freed,
+                               "by_model": dict(sorted(by_model.items()))}
+        if audits:
+            last = audits[-1]
+            mem["audit"] = {
+                "live_bytes": last.get("live_bytes"),
+                "accounted_bytes": last.get("accounted_bytes"),
+                "unaccounted_bytes": last.get("unaccounted_bytes")}
+        if mem:
+            report["memory"] = mem
+
     # -- compile cache (compile_cache.* events) ----------------------------
     cc = [e for e in events if e.get("type") == "compile_cache"]
     if cc:
@@ -424,12 +480,15 @@ def build_report(path: str, top: int = 10,
     return report
 
 
-def render_report(path: str, top: int = 10) -> str:
-    """The full text report for one event log."""
+def render_report(path, top: int = 10) -> str:
+    """The full text report for one event log (or a list of per-pid
+    sidecar logs, merged)."""
     r = build_report(path, top=top)
-    out: List[str] = [f"run report: {path}",
+    out: List[str] = [f"run report: {r['path']}",
                       f"{r['events']} events "
                       f"({r['spans']} spans, {r['metrics']} metrics)", ""]
+    if len(r.get("paths", ())) > 1:
+        out.insert(1, f"merged from {len(r['paths'])} event log(s)")
 
     if "stages" in r:
         rows = [[s["span"], s["count"], f"{s['total_s']:.4f}",
@@ -594,6 +653,32 @@ def render_report(path: str, top: int = 10) -> str:
                 f"  rollout {ro['model']} -> {ro['version']}: "
                 f"{ro['shifted']} replica(s) shifted, "
                 f"{ro['warmed']} warmed, {ro['status']}")
+        out.append("")
+
+    if "slo" in r:
+        out.append("slo:")
+        for name, o in r["slo"]["objectives"].items():
+            out.append(
+                f"  {name}: {o['burns']} burn(s), "
+                f"{o['breaches']} breach(es), {o['recovers']} recover(s); "
+                f"max fast burn {o['max_burn_fast']:.2f}x budget")
+        out.append("")
+
+    if "memory" in r:
+        mem = r["memory"]
+        out.append("hbm memory:")
+        if "pressure" in mem:
+            detail = ", ".join(f"{k}={v}" for k, v in
+                               mem["pressure"]["by_model"].items())
+            out.append(
+                f"  pressure evictions: {mem['pressure']['count']} "
+                f"({detail}); {mem['pressure']['freed_bytes']} bytes freed")
+        if "audit" in mem:
+            a = mem["audit"]
+            out.append(
+                f"  last audit: {a.get('live_bytes')} live, "
+                f"{a.get('accounted_bytes')} accounted, "
+                f"{a.get('unaccounted_bytes')} unaccounted")
         out.append("")
 
     if "compile_cache" in r:

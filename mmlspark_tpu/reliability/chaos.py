@@ -34,6 +34,7 @@ what makes a red chaos run *debuggable* instead of an anecdote.
 """
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import random
@@ -319,6 +320,24 @@ def run_fleet_scenario(seed: int, outdir: str, replicas: int = 3,
     - ``replicas_stay_probed`` — every health probe round answered for
       every replica (dead replicas ANSWER dead; probing never wedges).
 
+    The scenario also runs the observability stack against itself: a
+    :class:`~mmlspark_tpu.observability.aggregate.FleetScraper` +
+    :class:`~mmlspark_tpu.observability.slo.SloEngine` pair on a virtual
+    clock (30 s per request round, so burn windows slide inside a
+    seconds-long run) watches the whole incident, and a **recovery
+    phase** keeps healthy traffic flowing until the incident leaves both
+    windows. Four more invariants come from that aggregated view alone:
+
+    - ``readiness_flip_observed`` — the kill shows up as a ready-count
+      drop in the scraped fleet view (and never before the kill);
+    - ``slo_burn_on_kill``        — availability burn crosses the fast
+      threshold after the kill (failovers count as budget burn even
+      though the retry layer hid them from the client);
+    - ``slo_clears_after_recovery`` — burn decays back below threshold
+      once healthy traffic has aged the incident out of the windows;
+    - ``no_false_breach``         — ``slo.breach`` never fires before
+      the kill and is clear again at the end.
+
     The verdict's ``schedule`` (kill point, killed replica, per-request
     serving replica, failover count) is a pure function of ``seed`` —
     two same-seed runs must produce byte-identical schedules, which is
@@ -327,6 +346,8 @@ def run_fleet_scenario(seed: int, outdir: str, replicas: int = 3,
     import numpy as np
 
     from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.observability.aggregate import FleetScraper
+    from mmlspark_tpu.observability.slo import SloEngine
     from mmlspark_tpu.reliability.retry import RetryPolicy
     from mmlspark_tpu.serve.fleet import Fleet
     from mmlspark_tpu.serve.server import Server
@@ -374,6 +395,28 @@ def run_fleet_scenario(seed: int, outdir: str, replicas: int = 3,
     results: List[Optional[Any]] = []
     failed = 0
     probe_rounds: List[Dict[str, str]] = []
+
+    # the SLO watcher: one virtual-clock scrape per request round (30 s of
+    # virtual time each), so the 5-minute fast window is 10 rounds wide and
+    # the whole burn/recover cycle fits inside a seconds-long scenario
+    vclock = {"t": 1000.0}
+    scraper = FleetScraper(fleet, clock=lambda: vclock["t"])
+    engine = SloEngine(clock=lambda: vclock["t"],
+                       fast_window_s=300.0, slow_window_s=900.0)
+    slo_trace: List[Dict[str, Any]] = []
+
+    def observe_fleet() -> None:
+        snap = scraper.scrape()
+        status = engine.observe(scraper.slo_sample(snap))
+        slo_trace.append({
+            "t": vclock["t"],
+            "ready": sum(1 for r in snap["replicas"].values()
+                         if r.get("ready")),
+            "burning": any(s["burning"] for s in status),
+            "breaching": any(s["breaching"] for s in status),
+        })
+        vclock["t"] += 30.0
+
     try:
         for i, x in enumerate(stream):
             # probe BEFORE this round's kill: the kill must be discovered
@@ -394,7 +437,16 @@ def run_fleet_scenario(seed: int, outdir: str, replicas: int = 3,
                 results.append(None)
                 errors.append(
                     f"request {i}: {type(e).__name__}: {e}")
+            observe_fleet()
         probe_rounds.append(fleet.router.probe())
+        # phase 3: recovery — healthy traffic while the virtual clock ages
+        # the incident out of both burn windows (10 rounds x 120 s > the
+        # 900 s slow window); the engine must come back clean
+        for x in itertools.islice(itertools.cycle(stream), 10):
+            fleet.router.probe()
+            client_retry.call(fleet.submit, "chaos", x)
+            vclock["t"] += 90.0  # on top of observe_fleet's own 30 s
+            observe_fleet()
         stats = fleet.stats()
     finally:
         fleet.close()
@@ -418,12 +470,39 @@ def run_fleet_scenario(seed: int, outdir: str, replicas: int = 3,
         "probe_rounds": len(probe_rounds),
         "final_states": probe_rounds[-1] if probe_rounds else {},
     }
+
+    # the incident as the aggregated view saw it: trace index == request
+    # index through the stream (one scrape per round), then 10 recovery
+    # rounds. The kill lands at trace index ``kill_at`` (kill precedes
+    # that round's submit, so its scrape already sees the dead replica).
+    pre_kill = slo_trace[:kill_at]
+    post_kill = slo_trace[kill_at:]
+    tail = slo_trace[-3:]
+    burn_observed = any(e["burning"] for e in post_kill)
+    breach_observed = any(e["breaching"] for e in post_kill)
+    slo_clean_after = all(not e["burning"] and not e["breaching"]
+                          for e in tail)
+    no_false_breach = (all(not e["breaching"] for e in pre_kill)
+                       and slo_clean_after)
+    ready_flip = (all(e["ready"] == replicas for e in pre_kill)
+                  and any(e["ready"] < replicas for e in post_kill))
+    verdict["slo"] = {
+        "kill_trace_index": kill_at,
+        "burn_observed": burn_observed,
+        "breach_observed": breach_observed,
+        "clean_at_end": slo_clean_after,
+        "trace": slo_trace,
+    }
     invariants = {
         "zero_failed_requests": failed == 0,
         "scores_bit_identical": identical,
         "failover_observed": failovers >= 1,
         "replicas_stay_probed": probed_ok,
         "no_unhandled_exceptions": not errors,
+        "readiness_flip_observed": ready_flip,
+        "slo_burn_on_kill": burn_observed,
+        "slo_clears_after_recovery": slo_clean_after,
+        "no_false_breach": no_false_breach,
     }
     verdict["invariants"] = invariants
     verdict["errors"] = errors

@@ -72,6 +72,14 @@ until the real device OOMs. All serve-side device memory goes through
 ``KVCacheManager`` or ``ModelRegistry``; deliberate exceptions mark the
 line ``# lint: allow-alloc``.
 
+Rule 11 — device-byte arithmetic (``.nbytes`` / ``.itemsize``) in
+``serve/`` outside ``observability/memory.py``: HBM accounting lives in
+one ledger so totals stay mutually consistent — a private size formula
+in a serve/ module drifts from the ledger's (padding, dtype, layout) and
+the occupancy gauges stop summing. Size arithmetic goes through
+``memory.nbytes_of`` / ``memory.param_bytes``; deliberate exceptions
+mark the line ``# lint: allow-bytes``.
+
 Shared core for ``tools/check_reliability.py`` (standalone CLI),
 ``mmlspark-tpu check`` (installed CLI), and the in-pytest gate
 (tests/test_reliability_lint.py) — same single source of truth pattern as
@@ -137,6 +145,10 @@ _ALLOW_ALLOC = "# lint: allow-alloc"
 _ALLOC_HOME = "serve/kvcache.py"
 _ALLOC_CALLS = ("zeros", "ones", "full", "empty", "zeros_like",
                 "ones_like", "full_like", "empty_like")
+_ALLOW_BYTES = "# lint: allow-bytes"
+# the ONE module allowed to do device-byte arithmetic (it IS the ledger)
+_BYTES_HOME = "observability/memory.py"
+_BYTES_ATTRS = ("nbytes", "itemsize")
 
 
 def _is_raw_sync(call: ast.Call) -> bool:
@@ -239,6 +251,8 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     compile_scoped = "serve/" in norm and not norm.endswith(_COMPILE_HOME)
     # Rule 10 scope: serve/ modules only, the KV-arena accountant exempt
     alloc_scoped = "serve/" in norm and not norm.endswith(_ALLOC_HOME)
+    # Rule 11 scope: serve/ modules only (the ledger home is outside it)
+    bytes_scoped = "serve/" in norm and not norm.endswith(_BYTES_HOME)
 
     def _allowed(lineno: int) -> bool:
         # marker anywhere on the offending line opts that line out
@@ -264,6 +278,10 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     def _alloc_allowed(lineno: int) -> bool:
         return (0 < lineno <= len(lines)
                 and _ALLOW_ALLOC in lines[lineno - 1])
+
+    def _bytes_allowed(lineno: int) -> bool:
+        return (0 < lineno <= len(lines)
+                and _ALLOW_BYTES in lines[lineno - 1])
 
     for node in ast.walk(tree):
         if (isinstance(node, ast.Call)
@@ -338,6 +356,15 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
                 "arena accountants cannot see; route through "
                 "KVCacheManager/ModelRegistry, or mark the line "
                 f"`{_ALLOW_ALLOC}`)")
+        elif (isinstance(node, ast.Attribute) and bytes_scoped
+                and node.attr in _BYTES_ATTRS
+                and not _bytes_allowed(node.lineno)):
+            problems.append(
+                f"{filename}:{node.lineno}: device-byte arithmetic "
+                f"(.{node.attr}) in serve/ outside {_BYTES_HOME} (private "
+                "size formulas drift from the HBM ledger's; route through "
+                "memory.nbytes_of/memory.param_bytes, or mark the line "
+                f"`{_ALLOW_BYTES}`)")
         elif (isinstance(node, ast.Call) and _is_raw_sync(node)
                 and not sync_home
                 and not _sync_allowed(node.lineno)):

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from mmlspark_tpu.observability import memory as devmem
 from mmlspark_tpu.observability import metrics
 from mmlspark_tpu.utils import config as mmlconfig
 from mmlspark_tpu.utils.logging import get_logger
@@ -102,8 +103,8 @@ class KVCacheManager:
         bt = int(mmlconfig.get("generate.kv_block_tokens"))
         arena_mb = float(mmlconfig.get("generate.arena_mb"))
         if arena_mb > 0:
-            per_block = (2 * layers * bt * heads * head_dim
-                         * np.dtype(dtype).itemsize)
+            per_block = devmem.nbytes_of((2, layers, bt, heads, head_dim),
+                                         dtype)
             num_blocks = max(2, int(arena_mb * 1e6 // per_block))
         else:
             seqs = int(mmlconfig.get("generate.max_sequences"))
@@ -114,10 +115,11 @@ class KVCacheManager:
 
     def arena_bytes(self) -> int:
         """Total HBM footprint of both arenas (charged to the owning
-        registry entry so the device-cache LRU accounts for it)."""
-        per = (self.layers * self.num_blocks * self.block_tokens
-               * self.heads * self.head_dim * self.dtype.itemsize)
-        return 2 * per
+        registry entry so the device-cache LRU accounts for it); the
+        arithmetic itself lives in the HBM ledger (lint Rule 11)."""
+        return 2 * devmem.nbytes_of(
+            (self.layers, self.num_blocks, self.block_tokens,
+             self.heads, self.head_dim), self.dtype)
 
     # -- ledger ------------------------------------------------------------
     def try_reserve(self, seq_id: str, tokens: int) -> Optional[List[int]]:

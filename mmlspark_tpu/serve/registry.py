@@ -29,15 +29,13 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from mmlspark_tpu.observability import memory as devmem
 from mmlspark_tpu.reliability.breaker import CircuitBreaker
 from mmlspark_tpu.utils import config as mmlconfig
 
-
-def _param_bytes(params) -> int:
-    import jax
-    return sum(int(np.prod(l.shape)) * l.dtype.itemsize
-               for l in jax.tree_util.tree_leaves(params)
-               if hasattr(l, "shape"))
+# size arithmetic lives in the HBM ledger (lint Rule 11); this alias keeps
+# the registry's historical spelling working
+_param_bytes = devmem.param_bytes
 
 
 class ModelEntry:
@@ -234,6 +232,7 @@ class ModelRegistry:
         fits the budget. ``entry`` itself is exempt — a single over-budget
         model still serves (matching residency's force semantics), it just
         evicts everyone else."""
+        evicted: List[Tuple[str, int]] = []
         with self._lock:
             budget = self.budget_bytes()
             while self._resident() > budget:
@@ -242,8 +241,24 @@ class ModelRegistry:
                      if e.warm and e is not entry), None)
                 if victim is None:
                     break
+                freed = victim.resident_bytes()
                 victim.evict()
                 self.evictions += 1
+                evicted.append((victim.name, freed))
+            resident = self._resident()
+            warm = [(e.name, e._apply, e.kv_arena_bytes)
+                    for e in self._entries.values()]
+        ledger = devmem.get_ledger()
+        for name, freed in evicted:
+            ledger.on_eviction(name, freed, resident_bytes=resident,
+                               budget_bytes=budget)
+        # mirror the warm set into the ledger so the fleet view's
+        # {model, kind} bytes always match the registry's own accounting
+        for name, apply, kv in warm:
+            params = getattr(apply, "_params", None) if apply is not None \
+                else None
+            ledger.set_bytes(name, "params", devmem.param_bytes(params))
+            ledger.set_bytes(name, "kv", kv)
 
     def _resident(self) -> int:
         return sum(e.resident_bytes() for e in self._entries.values())

@@ -179,6 +179,10 @@ class Server:
         self._expired = _Twin("serving.expired")
         self._completed = _Twin("serving.completed")
         self._failed = _Twin("serving.failed")
+        # per-instance latency histogram: the process-wide
+        # serving.total_ms aggregates across in-process fleet replicas,
+        # but the fleet scraper and stats() need THIS replica's p50/p99
+        self._latency = metrics.Histogram("serving.total_ms")
         # generative lanes (serve/generate.py), one per decoder-LM model,
         # created lazily on the first submit_generate
         self._lanes: Dict[str, object] = {}
@@ -571,6 +575,7 @@ class Server:
                     queue_s * 1e3, exemplar=ex)
                 metrics.histogram("serving.total_ms").observe(
                     total_s * 1e3, exemplar=ex)
+                self._latency.observe(total_s * 1e3, exemplar=ex)
             if log:
                 events.emit("serving", "request", model=t.model,
                             rows=t.rows, bucket=bucket,
@@ -622,6 +627,12 @@ class Server:
              root_start + queue_s + pad_s, compute_s)
 
     # -- introspection -----------------------------------------------------
+    @property
+    def latency(self) -> metrics.Histogram:
+        """THIS replica's total-latency histogram (the fleet scraper
+        exports it as a per-replica labeled series)."""
+        return self._latency
+
     def stats(self) -> Dict[str, float]:
         s = {"admitted": self._admitted.value,
              "shed": self._shed.value,
@@ -630,7 +641,9 @@ class Server:
              "failed": self._failed.value,
              "inflight": self.inflight,
              "queue_depth": self._queue.qsize(),
-             "pending_rows": self._batcher.pending_rows}
+             "pending_rows": self._batcher.pending_rows,
+             "p50_ms": round(self._latency.percentile(50), 3),
+             "p99_ms": round(self._latency.percentile(99), 3)}
         s.update({f"registry.{k}": v
                   for k, v in self.registry.stats().items()})
         for name, lane in self._lanes.items():

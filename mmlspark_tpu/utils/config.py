@@ -115,6 +115,20 @@ _DEFAULTS: Dict[str, Any] = {
     "observability.flight_recorder_size": 256,  # last-N in-memory event
                                                 # ring, dumped on stall/
                                                 # chaos-red/crash (0 = off)
+    "observability.scrape_interval_s": 5.0,  # FleetScraper background poll
+                                             # cadence (start_scraper)
+    "observability.memory_poll_s": 0.0,      # >0 = periodic HBM ledger
+                                             # audit (jax.live_arrays sweep)
+    # SLO objectives (observability/slo.py): evaluated over rolling
+    # windows against the aggregated fleet view with multi-window
+    # burn-rate alerting (fast/slow windows, SRE-workbook recipe)
+    "slo.availability_target": 0.999,  # 1 - bad/admitted objective
+    "slo.latency_p99_ms": 0.0,         # >0 = p99 total-latency budget (ms)
+    "slo.ttft_p99_ms": 0.0,            # >0 = generate-lane TTFT p99 budget
+    "slo.fast_window_s": 300.0,        # fast burn window (page-now signal)
+    "slo.slow_window_s": 3600.0,       # slow burn window (sustained burn)
+    "slo.fast_burn": 14.4,             # burn-rate threshold, fast window
+    "slo.slow_burn": 6.0,              # burn-rate threshold, slow window
 }
 
 _lock = threading.Lock()

@@ -1,0 +1,616 @@
+"""Fleet-wide observability (observability/{aggregate,slo,memory,
+dashboard}.py): metrics aggregation, SLO burn-rate alerting, the HBM
+ledger, and ``mmlspark-tpu top``.
+
+Everything runs on CPU with injected clocks — burn windows, scraper
+breaker cooldowns, and dashboard rates are all driven by fake time. The
+acceptance spine:
+
+- a 3-replica in-process fleet under load with one replica killed
+  mid-run shows, from the AGGREGATED view alone: the readiness flip, the
+  availability burn crossing the fast threshold, ``slo.breach`` in the
+  flight-recorder dump, per-replica labeled Prometheus series, and HBM
+  ledger bytes that match the registry's own accounting;
+- the SLO engine's fast/slow windows slide correctly under an injected
+  clock (burn, breach, recover, counter-reset tolerance);
+- a replica that keeps failing its scrape trips that replica's breaker
+  (``circuit_open`` in the snapshot) and recovers after the cooldown;
+- one bucket-interpolation percentile helper serves report, bench, and
+  server stats alike (satellite: empty / single-bucket / +Inf edges);
+- ``mmlspark-tpu report`` merges multiple per-pid event logs (explicit
+  paths and ``--glob``) and renders the SLO + memory sections;
+- ``mmlspark-tpu top --once`` renders one frame against real HTTP
+  replicas.
+"""
+import io
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.observability import events, flightrec
+from mmlspark_tpu.observability import memory as devmem
+from mmlspark_tpu.observability import metrics
+from mmlspark_tpu.observability.aggregate import (
+    AggregatedRegistry, FleetScraper, expand_event_paths,
+    merge_cumulative, merge_event_logs, parse_prometheus_text,
+)
+from mmlspark_tpu.observability.dashboard import TopDashboard, format_bytes
+from mmlspark_tpu.observability.report import build_report, render_report
+from mmlspark_tpu.observability.slo import (
+    Objective, SloEngine, fraction_le, objectives_from_config,
+)
+from mmlspark_tpu.reliability.retry import RetryPolicy
+from mmlspark_tpu.serve import Fleet, Server
+from mmlspark_tpu.utils import config
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Fresh process registry, empty flight-recorder ring, zeroed HBM
+    ledger around every test — all three are process-global."""
+    metrics.get_registry().reset()
+    flightrec.clear()
+    devmem.get_ledger().reset()
+    yield
+    metrics.get_registry().reset()
+    flightrec.clear()
+    devmem.get_ledger().reset()
+
+
+def make_model(dim=8, classes=3, seed=0):
+    m = JaxModel(inputCol="x", outputCol="y", miniBatchSize=8)
+    m.set_model("mlp_tabular", input_dim=dim, hidden=[16],
+                num_classes=classes, seed=seed)
+    return m
+
+
+def _ticker(start=0.0):
+    state = {"now": float(start)}
+
+    def clock():
+        return state["now"]
+    clock.advance = lambda dt: state.__setitem__("now", state["now"] + dt)
+    return clock
+
+
+# -- percentile helper (satellite: one interpolation, all call sites) --------
+
+def test_nearest_rank_edges():
+    assert metrics.nearest_rank([], 99) == 0.0
+    assert metrics.nearest_rank([7.0], 50) == 7.0
+    assert metrics.nearest_rank([7.0], 99) == 7.0
+    # matches the repo's historical idiom: index round(p/100 * (n-1))
+    vals = [float(i) for i in range(101)]
+    assert metrics.nearest_rank(vals, 50) == 50.0
+    assert metrics.nearest_rank(vals, 99) == 99.0
+
+
+def test_percentile_from_buckets_empty_and_single():
+    assert metrics.percentile_from_buckets({}, 99) == 0.0
+    assert metrics.percentile_from_buckets({"10": 0, "+Inf": 0}, 50) == 0.0
+    # single finite bucket: interpolates inside [0, bound]
+    p = metrics.percentile_from_buckets({"10": 4, "+Inf": 4}, 50)
+    assert 0.0 < p <= 10.0
+
+
+def test_percentile_from_buckets_interpolates_and_clamps_inf():
+    cum = {"1": 0, "2": 10, "4": 10, "+Inf": 10}
+    # all 10 observations sit in (1, 2]: median interpolates inside it
+    p50 = metrics.percentile_from_buckets(cum, 50)
+    assert 1.0 < p50 <= 2.0
+    # overflow observations clamp to the highest FINITE bound, never Inf
+    cum_inf = {"1": 0, "2": 5, "+Inf": 10}
+    p99 = metrics.percentile_from_buckets(cum_inf, 99)
+    assert p99 == 2.0
+    # float-inf keys are accepted too
+    assert metrics.percentile_from_buckets(
+        {1.0: 0, 2.0: 5, float("inf"): 10}, 99) == 2.0
+
+
+def test_histogram_percentile_and_exemplar_preserved():
+    h = metrics.Histogram("t.lat", buckets=(1, 10, 100))
+    for v in (0.5, 2, 3, 4, 50):
+        h.observe(v, exemplar="tr-1")
+    p50 = h.percentile(50)
+    assert 1.0 < p50 <= 10.0
+    assert h.percentile(99) <= 100.0
+    assert h.exemplar == {"trace_id": "tr-1", "value": 50.0}
+
+
+# -- HBM ledger ---------------------------------------------------------------
+
+def test_ledger_set_total_snapshot_and_hwm():
+    led = devmem.MemoryLedger()
+    led.set_bytes("a", "params", 1000)
+    led.set_bytes("a", "kv", 500)
+    led.set_bytes("b", "params", 200)
+    assert led.total() == 1700
+    assert led.total(model="a") == 1500
+    assert led.total(kind="params") == 1200
+    snap = led.snapshot()
+    assert snap["total_bytes"] == 1700
+    assert snap["by_kind"] == {"params": 1200, "kv": 500, "program": 0}
+    assert snap["by_model"]["a"] == {"params": 1000, "kv": 500}
+    # high-watermark is monotonic through clears
+    led.clear("a")
+    assert led.total() == 200
+    assert led.high_watermark == 1700
+    # set_bytes(<=0) drops the line instead of keeping a zero series
+    led.set_bytes("b", "params", 0)
+    assert led.snapshot()["by_model"] == {}
+
+
+def test_ledger_note_program_idempotent_per_key():
+    led = devmem.MemoryLedger()
+    led.note_program("m", "/cache/prog-a", 100)
+    led.note_program("m", "/cache/prog-a", 100)   # reload: no double-charge
+    assert led.total(kind="program") == 100
+    led.note_program("m", "/cache/prog-b", 50)    # second bucket: sums
+    assert led.total(kind="program") == 150
+    led.clear("m", kind="program")
+    assert led.total(kind="program") == 0
+
+
+def test_nbytes_of_and_param_bytes():
+    assert devmem.nbytes_of((2, 3), np.float32) == 24
+    assert devmem.nbytes_of((), np.int8) == 1
+    assert devmem.param_bytes(None) == 0
+    params = {"w": np.zeros((4, 4), np.float32), "b": np.zeros(4, np.float32)}
+    assert devmem.param_bytes(params) == 64 + 16
+
+
+def test_ledger_eviction_emits_pressure_event_and_counter():
+    led = devmem.MemoryLedger()
+    led.set_bytes("victim", "params", 1000)
+    assert flightrec.active()
+    led.on_eviction("victim", 1000, resident_bytes=0, budget_bytes=512.0)
+    assert led.total(model="victim") == 0
+    assert metrics.counter("memory.pressure").value == 1
+    names = [(e["type"], e["name"]) for e in flightrec.snapshot()]
+    assert ("memory", "pressure") in names
+
+
+def test_registry_lru_eviction_lands_in_ledger():
+    from mmlspark_tpu.serve.registry import ModelRegistry
+    led = devmem.get_ledger()
+    reg = ModelRegistry(budget_mb=1e-9)           # fits nothing twice
+    ea = reg.add("a", make_model(seed=0))
+    eb = reg.add("b", make_model(seed=1))
+    ea.ensure_apply()
+    reg.touch(ea)
+    assert led.total(model="a", kind="params") > 0
+    eb.ensure_apply()
+    reg.touch(eb)                                 # b is MRU; a evicted
+    assert led.total(model="a") == 0              # victim's lines cleared
+    assert led.total(model="b", kind="params") == eb.resident_bytes()
+    assert metrics.counter("memory.pressure").value == 1
+    # the ledger mirrors the registry's own accounting exactly
+    assert led.total(kind="params") == reg.resident_bytes()
+
+
+def test_audit_device_bytes_reports_unaccounted():
+    out = devmem.audit_device_bytes()
+    if not out.get("supported"):
+        pytest.skip("jax.live_arrays unsupported on this platform")
+    assert out["accounted_bytes"] == 0
+    assert out["unaccounted_bytes"] == out["live_bytes"]
+    assert out["live_arrays"] >= 0
+
+
+# -- SLO engine (injected clock) ----------------------------------------------
+
+def test_fraction_le_interpolation_and_empty():
+    assert fraction_le({}, 5.0) == 1.0            # no traffic, no burn
+    cum = {"10": 5, "20": 10, "+Inf": 10}
+    assert fraction_le(cum, 10.0) == 0.5
+    assert fraction_le(cum, 15.0) == 0.75         # linear inside (10, 20]
+    assert fraction_le(cum, 20.0) == 1.0
+    assert fraction_le(cum, 999.0) == 1.0
+
+
+def test_objectives_from_config_gating():
+    objs = objectives_from_config()
+    assert [o.name for o in objs] == ["availability"]
+    config.set("slo.latency_p99_ms", 50.0)
+    try:
+        names = [o.name for o in objectives_from_config()]
+        assert names == ["availability", "latency_p99"]
+    finally:
+        config.unset("slo.latency_p99_ms")
+    with pytest.raises(ValueError):
+        Objective("bad", "availability", 1.5)
+
+
+def _avail_engine(clock, **kw):
+    return SloEngine([Objective("availability", "availability", 0.999)],
+                     clock=clock, fast_window_s=300.0, slow_window_s=900.0,
+                     **kw)
+
+
+def test_burn_windows_slide_under_injected_clock():
+    clock = _ticker(1000.0)
+    eng = _avail_engine(clock)
+    # healthy traffic: 10 admitted per 30s round, zero bad
+    admitted, bad = 0.0, 0.0
+    for _ in range(5):
+        admitted += 10
+        st = eng.observe({"t": clock(), "admitted": admitted, "bad": bad})[0]
+        clock.advance(30.0)
+    assert st["burn_fast"] == 0.0 and not st["burning"]
+    # an incident: 5 bad among the next 10 -> fast burn = 0.333/0.001
+    admitted += 10
+    bad += 5
+    st = eng.observe({"t": clock(), "admitted": admitted, "bad": bad})[0]
+    assert st["burning"] and st["burn_fast"] > 14.4
+    assert st["breaching"]                       # slow window covers it too
+    assert metrics.counter("slo.burns").value == 1
+    assert metrics.counter("slo.breaches").value == 1
+    ev = [(e["type"], e["name"]) for e in flightrec.snapshot()]
+    assert ("slo", "burn") in ev and ("slo", "breach") in ev
+    # healthy traffic ages the incident out of both windows -> recover
+    for _ in range(14):
+        clock.advance(90.0)
+        admitted += 10
+        st = eng.observe({"t": clock(), "admitted": admitted,
+                          "bad": bad})[0]
+    assert not st["burning"] and not st["breaching"]
+    assert ("slo", "recover") in [(e["type"], e["name"])
+                                  for e in flightrec.snapshot()]
+    # edge-triggered: the single incident counted exactly once
+    assert metrics.counter("slo.burns").value == 1
+
+
+def test_counter_reset_clears_history_not_burn():
+    clock = _ticker(0.0)
+    eng = _avail_engine(clock)
+    eng.observe({"t": clock(), "admitted": 100.0, "bad": 2.0})
+    clock.advance(30.0)
+    # a replica restart shrinks the cumulative totals: no negative deltas
+    st = eng.observe({"t": clock(), "admitted": 10.0, "bad": 0.0})[0]
+    assert st["burn_fast"] == 0.0 and not st["burning"]
+
+
+def test_latency_objective_burns_on_slow_buckets():
+    clock = _ticker(0.0)
+    eng = SloEngine([Objective("latency_p99", "latency", 0.99,
+                               budget_ms=10.0)],
+                    clock=clock, fast_window_s=300.0, slow_window_s=900.0)
+    # 100 requests all under budget
+    st = eng.observe({"t": clock(),
+                      "latency_buckets": {"10": 100, "+Inf": 100}})[0]
+    assert not st["burning"]
+    clock.advance(30.0)
+    # next 100: half blow the budget -> bad fraction ~0.5, burn ~50
+    st = eng.observe({"t": clock(),
+                      "latency_buckets": {"10": 150, "+Inf": 200}})[0]
+    assert st["burning"] and st["burn_fast"] > 14.4
+
+
+# -- aggregation primitives ---------------------------------------------------
+
+def test_aggregated_registry_prometheus_text_labels():
+    reg = AggregatedRegistry()
+    reg.set_value("serving.admitted", {"replica": "r0"}, 5, "counter")
+    reg.set_value("serving.admitted", {"replica": "r1"}, 7, "counter")
+    reg.set_histogram("serving.total_ms", {"replica": "r0"},
+                      {"10": 3, "+Inf": 4}, 44.0, 4,
+                      exemplar={"trace_id": "t1", "value": 30.0})
+    reg.set_value("memory.bytes", {"model": "mlp", "kind": "params"}, 780)
+    text = reg.prometheus_text()
+    assert 'serving_admitted{replica="r0"} 5' in text
+    assert 'serving_admitted{replica="r1"} 7' in text
+    assert 'serving_total_ms_bucket{replica="r0",le="10"} 3' in text
+    assert 'serving_total_ms_count{replica="r0"} 4' in text
+    assert 'memory_bytes{kind="params",model="mlp"} 780' in text
+    assert "# TYPE serving_admitted counter" in text
+    d = reg.to_dict()
+    assert d["serving.admitted"]["type"] == "counter"
+    assert len(d["serving.admitted"]["series"]) == 2
+
+
+def test_parse_prometheus_round_trip():
+    parsed = parse_prometheus_text("\n".join([
+        "# TYPE serving_admitted counter",
+        "serving_admitted 12",
+        "# TYPE serving_total_ms histogram",
+        'serving_total_ms_bucket{le="10"} 3',
+        'serving_total_ms_bucket{le="+Inf"} 4',
+        "serving_total_ms_sum 44.5",
+        "serving_total_ms_count 4",
+        "garbage line without a number ???",
+    ]))
+    assert parsed["serving_admitted"] == {"type": "counter", "value": 12.0}
+    h = parsed["serving_total_ms"]
+    assert h["type"] == "histogram"
+    assert h["buckets"] == {"10": 3.0, "+Inf": 4.0}
+    assert h["sum"] == 44.5 and h["count"] == 4.0
+
+
+def test_merge_cumulative_sums_shared_edges():
+    merged = merge_cumulative([{"10": 1, "+Inf": 2}, {"10": 3, "+Inf": 4}])
+    assert merged == {"10": 4.0, "+Inf": 6.0}
+
+
+# -- scraper breakers (injected clock) ----------------------------------------
+
+class _FlakyReplica:
+    """Replica-protocol stub whose health() raises until told to heal."""
+
+    def __init__(self, name):
+        self.name = name
+        self.failing = False
+
+    def health(self):
+        if self.failing:
+            raise ConnectionError("scrape refused")
+        return {"live": True, "ready": True, "state": "ready"}
+
+
+def test_scraper_breaker_opens_and_recovers_with_fake_clock():
+    clock = _ticker(0.0)
+    good, flaky = _FlakyReplica("r0"), _FlakyReplica("r1")
+    scraper = FleetScraper([good, flaky], clock=clock,
+                           breaker_failures=2, breaker_reset_s=60.0)
+    assert scraper.scrape()["replicas"]["r1"]["ready"]
+    flaky.failing = True
+    one = scraper.scrape()["replicas"]["r1"]
+    assert "ConnectionError" in one["error"]
+    snap = scraper.scrape()                       # second failure: trips
+    assert snap["replicas"]["r1"]["breaker"] == "open"
+    # while open the replica is SKIPPED, not re-probed
+    one = scraper.scrape()["replicas"]["r1"]
+    assert one["error"] == "circuit_open"
+    # the healthy replica is unaffected throughout
+    assert snap["replicas"]["r0"]["ready"]
+    # cooldown elapses on the injected clock -> half-open probe succeeds
+    flaky.failing = False
+    clock.advance(61.0)
+    one = scraper.scrape()["replicas"]["r1"]
+    assert one["ready"] and "error" not in one
+    # readiness gauges track the whole episode in the labeled registry
+    text = scraper.prometheus_text()
+    assert 'fleet_replica_ready{replica="r1"} 1' in text
+
+
+# -- event-log merging + report (satellite) -----------------------------------
+
+def _write_events(path, pid, rows, base=100.0):
+    with open(path, "w") as f:
+        for i, (etype, name, extra) in enumerate(rows):
+            e = {"ts": base + i, "pid": pid, "type": etype, "name": name}
+            e.update(extra)
+            f.write(json.dumps(e) + "\n")
+
+
+def test_merge_event_logs_orders_by_ts(tmp_path):
+    p1, p2 = tmp_path / "ev-100.jsonl", tmp_path / "ev-200.jsonl"
+    _write_events(p1, 100, [("span", "Fit", {"dur_ms": 5.0})], base=100.0)
+    _write_events(p2, 200, [("span", "Score", {"dur_ms": 3.0})], base=200.0)
+    merged = merge_event_logs([str(p2), str(p1)])
+    assert [e["pid"] for e in merged] == [100, 200]   # ts order, not arg
+
+
+def test_expand_event_paths_glob_and_dedup(tmp_path):
+    p1, p2 = tmp_path / "ev-1.jsonl", tmp_path / "ev-2.jsonl"
+    p1.write_text("")
+    p2.write_text("")
+    out = expand_event_paths([str(p1)], pattern=str(tmp_path / "ev-*.jsonl"))
+    assert out == [str(p1), str(p2)]                  # deduped, ordered
+    # inline glob in a positional path works too (shell didn't expand)
+    out = expand_event_paths([str(tmp_path / "ev-?.jsonl")])
+    assert out == [str(p1), str(p2)]
+
+
+def test_report_merges_multiple_logs_and_slo_memory_sections(tmp_path):
+    p1, p2 = tmp_path / "ev-100.jsonl", tmp_path / "ev-200.jsonl"
+    _write_events(p1, 100, [
+        ("serving", "request", {"total_ms": 4.0, "queue_ms": 1.0,
+                                "pad_ms": 0.0, "compute_ms": 3.0,
+                                "bucket": 8, "occupancy": 1.0}),
+        ("slo", "burn", {"objective": "availability", "burn_fast": 33.0,
+                         "burn_slow": 20.0, "target": 0.999}),
+        ("slo", "breach", {"objective": "availability", "burn_fast": 33.0,
+                           "burn_slow": 20.0, "target": 0.999}),
+        ("slo", "recover", {"objective": "availability", "burn_fast": 0.0,
+                            "burn_slow": 0.0, "target": 0.999}),
+    ])
+    _write_events(p2, 200, [
+        ("memory", "pressure", {"model": "mlp", "freed_bytes": 1000,
+                                "resident_bytes": 0, "budget_bytes": 512.0,
+                                "reason": "lru"}),
+        ("memory", "audit", {"supported": True, "live_bytes": 100,
+                             "accounted_bytes": 80, "live_arrays": 2,
+                             "unaccounted_bytes": 20}),
+    ])
+    rep = build_report([str(p1), str(p2)])
+    assert rep["paths"] == [str(p1), str(p2)]
+    avail = rep["slo"]["objectives"]["availability"]
+    assert avail["burns"] == 1
+    assert avail["breaches"] == 1
+    assert avail["recovers"] == 1
+    assert avail["max_burn_fast"] == 33.0
+    assert rep["memory"]["pressure"]["count"] == 1
+    assert rep["memory"]["pressure"]["freed_bytes"] == 1000
+    assert rep["memory"]["audit"]["unaccounted_bytes"] == 20
+    text = render_report([str(p1), str(p2)])
+    assert "merged from 2 event log(s)" in text
+    assert "slo:" in text and "hbm memory:" in text
+
+
+def test_cli_report_multi_path_and_glob(tmp_path, capsys):
+    from mmlspark_tpu.cli import main
+    p1, p2 = tmp_path / "ev-1.jsonl", tmp_path / "ev-2.jsonl"
+    _write_events(p1, 1, [("span", "Fit", {"dur_ms": 5.0})])
+    _write_events(p2, 2, [("span", "Score", {"dur_ms": 3.0})])
+    assert main(["report", str(p1), str(p2)]) == 0
+    assert "merged from 2" in capsys.readouterr().out
+    assert main(["report", "--glob", str(tmp_path / "ev-*.jsonl")]) == 0
+    assert "merged from 2" in capsys.readouterr().out
+    with pytest.raises(SystemExit):
+        main(["report", "--glob", str(tmp_path / "nothing-*.jsonl")])
+
+
+# -- dashboard ----------------------------------------------------------------
+
+def test_format_bytes():
+    assert format_bytes(0) == "0B"
+    assert format_bytes(999) == "999B"
+    assert format_bytes(1500) == "1.5KB"
+    assert format_bytes(2.34e9) == "2.3GB"
+
+
+def test_dashboard_renders_synthetic_snapshot():
+    clock = _ticker(10.0)
+    good = _FlakyReplica("r0")
+    scraper = FleetScraper([good], clock=clock)
+    out = io.StringIO()
+    dash = TopDashboard(scraper, SloEngine(clock=clock), clock=clock,
+                        out=out)
+    dash.run(once=True)
+    frame = out.getvalue()
+    assert "mmlspark-tpu top" in frame
+    assert "replicas 1/1 ready" in frame
+    assert "r0" in frame and "hbm" in frame
+    assert "\x1b[" not in frame                   # --once: no ANSI clear
+
+
+# -- the acceptance e2e: 3 replicas, one killed mid-run -----------------------
+
+def test_fleet_kill_visible_from_aggregated_view_alone():
+    config.set("observability.metrics", True)
+    clock = _ticker(1000.0)
+    fleet = Fleet({"mlp": make_model()}, replicas=3,
+                  server_kwargs=dict(max_batch=8, queue_depth=64))
+    scraper = FleetScraper(fleet, clock=clock)
+    engine = SloEngine(
+        [Objective("availability", "availability", 0.999)],
+        clock=clock, fast_window_s=300.0, slow_window_s=900.0)
+    retry = RetryPolicy(max_attempts=4, base_delay=0.0, jitter=0.0,
+                        name="t.fleetobs", seed=0)
+    X = np.random.default_rng(0).normal(size=(2, 8)).astype(np.float32)
+
+    def round_(n=2):
+        for _ in range(n):
+            retry.call(fleet.submit, "mlp", X)
+        snap = scraper.scrape()
+        st = engine.observe(scraper.slo_sample(snap))
+        clock.advance(30.0)
+        return snap, st
+
+    try:
+        # healthy phase
+        for _ in range(4):
+            snap, st = round_()
+        assert sum(1 for r in snap["replicas"].values()
+                   if r["ready"]) == 3
+        assert not any(s["burning"] for s in st)
+
+        # the HBM ledger matches the registry's own accounting (shared
+        # params across in-process replicas count ONCE in the ledger)
+        led = devmem.get_ledger()
+        assert led.total(model="mlp", kind="params") == \
+            fleet.servers[0].registry.resident_bytes()
+        assert snap["memory"]["total_bytes"] == \
+            sum(snap["memory"]["by_kind"].values())
+
+        # kill one replica mid-run; failover absorbs it
+        fleet.kill(1)
+        burned = False
+        for _ in range(3):
+            snap, st = round_()
+            burned = burned or any(s["burning"] for s in st)
+        # 1) readiness flip, visible in the scraped view
+        assert snap["replicas"]["r1"]["ready"] is False
+        assert sum(1 for r in snap["replicas"].values()
+                   if r["ready"]) == 2
+        # 2) the hidden failover burned availability budget anyway
+        assert snap["fleet"]["failovers"] >= 1
+        assert burned
+        assert any(s["breaching"] for s in st) or burned
+
+        # 3) slo.breach landed in the flight recorder
+        ev = [(e["type"], e["name"]) for e in flightrec.snapshot()]
+        assert ("slo", "burn") in ev
+        assert ("slo", "breach") in ev
+
+        # 4) per-replica labeled Prometheus series, one exposition page
+        text = scraper.prometheus_text()
+        for name in ("r0", "r1", "r2"):
+            assert f'serving_admitted{{replica="{name}"}}' in text
+        assert 'fleet_replica_ready{replica="r1"} 0' in text
+        assert 'fleet_replica_ready{replica="r0"} 1' in text
+        assert 'memory_bytes{kind="params",model="mlp"}' in text
+        assert "serving_total_ms_bucket" in text
+
+        # 5) per-replica latency percentiles from the per-instance twins
+        stats0 = snap["replicas"]["r0"]["stats"]
+        assert stats0["p99_ms"] >= stats0["p50_ms"] > 0.0
+        assert snap["fleet"]["p99_ms"] >= snap["fleet"]["p50_ms"] > 0.0
+
+        # 6) top renders the whole thing in one frame
+        out = io.StringIO()
+        TopDashboard(scraper, engine, clock=clock, out=out).run(once=True)
+        frame = out.getvalue()
+        assert "replicas 2/3 ready" in frame
+        assert "NO" in frame                     # the dead replica's row
+        assert "slo      availability" in frame
+        assert "hbm" in frame and "mlp" in frame
+    finally:
+        fleet.close()
+        config.unset("observability.metrics")
+
+
+def test_scraper_background_loop_and_slo_sample_shape():
+    fleet = Fleet({"mlp": make_model()}, replicas=2,
+                  server_kwargs=dict(max_batch=8, queue_depth=32))
+    scraper = FleetScraper(fleet)
+    try:
+        fleet.submit("mlp", np.zeros((2, 8), np.float32))
+        scraper.start(interval_s=0.01)
+        deadline = events.perf() + 5.0
+        while scraper.last is None and events.perf() < deadline:
+            threading.Event().wait(0.01)
+        assert scraper.last is not None
+        scraper.stop()
+        sample = scraper.slo_sample(scraper.last)
+        assert sample["admitted"] >= 1.0
+        assert sample["bad"] == 0.0
+        assert "t" in sample
+        assert metrics.get_registry().to_dict()["fleet.scrape_ms"][
+            "count"] >= 1
+    finally:
+        scraper.stop()
+        fleet.close()
+
+
+# -- CLI top --once over real HTTP replicas -----------------------------------
+
+def test_cli_top_once_against_http_server(capsys):
+    from mmlspark_tpu.cli import main
+    from mmlspark_tpu.serve.http import serve_http
+    config.set("observability.metrics", True)
+    srv = Server({"mlp": make_model()}, max_batch=4, max_wait_ms=1.0)
+    httpd, addr = serve_http(srv, port=0)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        srv.submit("mlp", np.zeros((2, 8), np.float32), timeout=30)
+        assert main(["top", "--replica", addr, "--once"]) == 0
+        frame = capsys.readouterr().out
+        assert "mmlspark-tpu top" in frame
+        assert "replicas 1/1 ready" in frame
+        assert addr in frame
+    finally:
+        srv.close()
+        httpd.shutdown()
+        httpd.server_close()
+        config.unset("observability.metrics")
+
+
+def test_cli_top_requires_replicas():
+    from mmlspark_tpu.cli import main
+    with pytest.raises(SystemExit):
+        main(["top", "--once"])

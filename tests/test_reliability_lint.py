@@ -451,3 +451,50 @@ def test_alloc_rule_marker_and_host_allocs():
         def also_host(x):
             return np.full_like(x, -1)
     """), filename="mmlspark_tpu/serve/server.py") == []
+
+
+def test_flags_byte_arithmetic_in_serve():
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def footprint(arr, dt):
+            per = np.dtype(dt).itemsize
+            return arr.nbytes + 4 * per
+    """)
+    probs = lint.check_source(
+        src, filename="mmlspark_tpu/serve/registry.py")
+    assert len(probs) == 2
+    assert all("device-byte arithmetic" in p for p in probs)
+    assert "allow-bytes" in probs[0]            # the escape hatch is named
+    assert "observability/memory.py" in probs[0]   # and the ledger home
+
+
+def test_bytes_rule_scoped_to_serve_and_home_exempt():
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def nbytes_of(shape, dtype):
+            n = 1
+            for d in shape:
+                n *= int(d)
+            return n * np.dtype(dtype).itemsize
+    """)
+    # the ledger IS the sanctioned home for size arithmetic
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/observability/memory.py") == []
+    # outside serve/ the rule does not apply (featurizers legitimately
+    # size host buffers)
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/featurize/image.py") == []
+
+
+def test_bytes_rule_marker_and_delegation_spelling():
+    assert lint.check_source(textwrap.dedent("""
+        from mmlspark_tpu.observability import memory as devmem
+
+        def footprint(arr):
+            return arr.nbytes  # lint: allow-bytes
+
+        def delegated(shape, dt):
+            return devmem.nbytes_of(shape, dt)
+    """), filename="mmlspark_tpu/serve/kvcache.py") == []
