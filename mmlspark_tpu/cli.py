@@ -351,6 +351,14 @@ def cmd_serve(args, passthrough) -> int:
     from mmlspark_tpu.serve.http import serve_http
     from mmlspark_tpu.serve.server import Server
     from mmlspark_tpu.utils import config as mmlconfig
+    if getattr(args, "events_dir", ""):
+        # per-pid sidecar convention: this worker appends to its OWN
+        # events-<pid>.jsonl under the shared directory; the supervisor
+        # (or `mmlspark-tpu report --glob`) merges them into one view
+        os.makedirs(args.events_dir, exist_ok=True)
+        mmlconfig.set("observability.events_path",
+                      os.path.join(args.events_dir,
+                                   f"events-{os.getpid()}.jsonl"))
     # second startup against a warm runtime.compile_cache_dir skips every
     # bucket compile: jax's cache for jit paths + the AOT program cache
     # consulted by ModelEntry._compile (docs/PERFORMANCE.md)
@@ -400,8 +408,9 @@ def cmd_serve(args, passthrough) -> int:
     h = front.health()
     print(json.dumps({"serving": addr,                 # lint: allow-print
                       "models": front.registry.names(),
-                      "replicas": args.replicas,
-                      "live": h["live"], "ready": h["ready"]}))
+                      "replicas": args.replicas, "pid": os.getpid(),
+                      "live": h["live"], "ready": h["ready"]}),
+          flush=True)  # a supervisor reads this over a block-buffered pipe
     # graceful preemption: SIGTERM/SIGINT flip the process-wide signal;
     # this monitor turns it into drain (stop admission, finish in-flight)
     # then unblocks serve_forever. Handlers only install on the main
@@ -440,6 +449,93 @@ def cmd_serve(args, passthrough) -> int:
     return 0
 
 
+def cmd_fleet(args, passthrough) -> int:
+    """Launch a REAL process fleet (docs/SERVING.md "Process fleet"):
+    every replica is its own ``mmlspark-tpu serve`` OS process — own
+    ephemeral port, own ``events-<pid>.jsonl`` sidecar, the SHARED
+    persistent compile cache — supervised with restart-on-crash
+    (exponential backoff + per-replica circuit breaker) behind the
+    health-checked HTTP router. SIGTERM drains every child before the
+    front closes. Args after ``--`` are forwarded to each worker's
+    ``serve`` command line verbatim."""
+    import threading
+    from mmlspark_tpu.observability.aggregate import FleetScraper
+    from mmlspark_tpu.reliability import preemption
+    from mmlspark_tpu.serve.http import serve_http
+    from mmlspark_tpu.serve.router import Router
+    from mmlspark_tpu.serve.supervisor import ProcessSpawner, Supervisor
+    from mmlspark_tpu.utils import config as mmlconfig
+    if not args.model:
+        raise SystemExit(
+            "fleet: at least one --model NAME=ARCH[:JSON-kwargs] required "
+            '(e.g. --model "mlp=mlp_tabular:{\\"input_dim\\": 8}")')
+    for spec in args.model:
+        _parse_model_flag(spec)  # fail fast BEFORE spawning any worker
+    replicas = args.replicas if args.replicas is not None \
+        else int(mmlconfig.get("fleet.replicas"))
+    if replicas < 1:
+        raise SystemExit(f"fleet: --replicas must be >= 1, got {replicas}")
+    events_dir = args.events_dir or os.path.join(os.getcwd(), "fleet-events")
+    os.makedirs(events_dir, exist_ok=True)
+    # the supervisor writes its OWN per-pid sidecar next to the workers'
+    # so the merged report carries the supervisor.* decisions too:
+    #   mmlspark-tpu report --glob 'EVENTS_DIR/events-*.jsonl'
+    mmlconfig.set("observability.events_path",
+                  os.path.join(events_dir, f"events-{os.getpid()}.jsonl"))
+    cache_dir = args.compile_cache_dir \
+        or str(mmlconfig.get("runtime.compile_cache_dir"))
+    spawner = ProcessSpawner(
+        args.model, host=args.host, events_dir=events_dir,
+        compile_cache_dir=cache_dir or None,
+        extra_args=list(passthrough))
+    sup = Supervisor(spawner, [f"w{i}" for i in range(replicas)])
+    scraper = None
+    httpd = None
+    try:
+        sup.start()
+        router = Router(sup.replicas)
+        sup.attach_router(router)
+        router.probe()
+        router.start_prober()
+        # background fleet scrape keeps the aggregated per-replica view
+        # warm for `mmlspark-tpu top` pointed at the workers
+        scraper = FleetScraper(router)
+        scraper.start()
+        sup.start_monitor()
+        httpd, addr = serve_http(router, host=args.host, port=args.port)
+        h = router.health()
+        print(json.dumps({"serving": addr,             # lint: allow-print
+                          "replicas": replicas, "pid": os.getpid(),
+                          "workers": sup.stats(),
+                          "events_dir": events_dir,
+                          "live": h["live"], "ready": h["ready"]},
+                         default=str), flush=True)
+        # SIGTERM/SIGINT -> drain every child through its own preemption
+        # handler, stop restarting, then unblock serve_forever
+        preemption.install_handlers()
+
+        def monitor():
+            preemption.get_signal().wait()
+            reason = preemption.preemption_reason() or "signal"
+            sup.shutdown(reason=reason)
+            httpd.shutdown()
+
+        mon = threading.Thread(target=monitor, daemon=True,
+                               name="mmlspark-tpu-fleet-drain")
+        mon.start()
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass  # clean Ctrl-C shutdown path
+    finally:
+        if httpd is not None:
+            httpd.server_close()
+        if scraper is not None:
+            scraper.stop()
+        sup.shutdown()
+    return 0
+
+
 def cmd_chaos(args, passthrough) -> int:
     """Seeded chaos scenario (docs/RELIABILITY.md). ``--scenario train``
     (default): train under a deterministic fault schedule generated from
@@ -449,10 +545,19 @@ def cmd_chaos(args, passthrough) -> int:
     scores bit-identical to a single server, deterministic schedule.
     ``--scenario decode``: kill a replica MID-GENERATION; every sequence
     completes via failover-restart from its prompt with token streams
-    bit-identical to a single server (seeded sampling).
+    bit-identical to a single server (seeded sampling). ``--scenario
+    host``: SIGKILL a real worker PROCESS under fire; the supervisor
+    warm-restarts it from the shared compile cache with zero failed
+    requests, and a crash-looper ends breaker-open, not flapping.
     Writes ``chaos_verdict.json`` under --out; exit 0 iff every
     invariant held."""
     from mmlspark_tpu.reliability import chaos
+    if args.scenario not in chaos.SCENARIOS:
+        known = "\n".join(f"  {name:8s} {desc}" for name, desc
+                          in sorted(chaos.SCENARIOS.items()))
+        print(f"chaos: unknown scenario {args.scenario!r}; "  # lint: allow-print
+              f"registered scenarios:\n{known}", file=sys.stderr)
+        return 2
     outdir = args.out or os.path.join(
         os.getcwd(), f"chaos-{args.scenario}-seed{args.seed}")
     if args.scenario == "fleet":
@@ -461,6 +566,10 @@ def cmd_chaos(args, passthrough) -> int:
             requests=args.requests)
     elif args.scenario == "decode":
         verdict = chaos.run_decode_scenario(
+            args.seed, outdir, replicas=args.replicas,
+            requests=args.requests)
+    elif args.scenario == "host":
+        verdict = chaos.run_host_scenario(
             args.seed, outdir, replicas=args.replicas,
             requests=args.requests)
     else:
@@ -584,7 +693,41 @@ def main(argv: Optional[List[str]] = None) -> int:
                          help="in-process serving replicas behind the "
                          "fleet router (failover, health probing, "
                          "rolling rollout; default 1 = plain server)")
+    serve_p.add_argument("--events-dir", default="",
+                         help="write this process's telemetry to "
+                         "EVENTS_DIR/events-<pid>.jsonl (the per-pid "
+                         "sidecar convention; supervisors and `report "
+                         "--glob` merge them)")
     serve_p.set_defaults(fn=cmd_serve)
+
+    fleet_p = sub.add_parser(
+        "fleet",
+        help="launch N `serve` worker PROCESSES behind the router, "
+             "supervised with restart-on-crash (backoff + breaker); "
+             "SIGTERM drains every child")
+    fleet_p.add_argument("--model", action="append", default=[],
+                         metavar="NAME=ARCH[:JSON-kwargs]",
+                         help="model spec forwarded to every worker "
+                         "(repeatable)")
+    fleet_p.add_argument("--host", default="127.0.0.1")
+    fleet_p.add_argument("--port", type=int, default=8080,
+                         help="front router port (0 = ephemeral, "
+                         "announced on stdout); workers always bind "
+                         "ephemeral ports")
+    fleet_p.add_argument("--replicas", type=int, default=None,
+                         help="worker process count (default: "
+                         "fleet.replicas config)")
+    fleet_p.add_argument("--events-dir", default="",
+                         help="shared telemetry directory: every process "
+                         "(workers AND supervisor) appends its own "
+                         "events-<pid>.jsonl there (default "
+                         "./fleet-events)")
+    fleet_p.add_argument("--compile-cache-dir", default="",
+                         help="shared persistent compile cache exported "
+                         "to every worker; restarted replicas LOAD "
+                         "compiled programs instead of recompiling "
+                         "(default: runtime.compile_cache_dir)")
+    fleet_p.set_defaults(fn=cmd_fleet)
 
     chaos_p = sub.add_parser(
         "chaos",
@@ -592,12 +735,14 @@ def main(argv: Optional[List[str]] = None) -> int:
              "kill-a-fleet-replica-under-fire); exits 0 iff all "
              "invariants hold")
     chaos_p.add_argument("--scenario", default="train",
-                         choices=["train", "fleet", "decode"],
                          help="train: kill+resume then serve under faults; "
                          "fleet: kill one of N replicas mid-stream; "
                          "decode: kill a replica mid-generation, every "
-                         "sequence completes via failover-restart "
-                         "(default: train)")
+                         "sequence completes via failover-restart; "
+                         "host: SIGKILL a worker PROCESS under fire, "
+                         "warm restart from the shared compile cache "
+                         "(default: train; unknown scenarios list the "
+                         "registry and exit 2)")
     chaos_p.add_argument("--seed", type=int, default=0,
                          help="fault-schedule seed (same seed => same "
                          "kills, same verdict)")
@@ -611,7 +756,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     chaos_p.add_argument("--requests", type=int, default=12,
                          help="serve-phase request count (default 12)")
     chaos_p.add_argument("--replicas", type=int, default=3,
-                         help="fleet width for --scenario fleet "
+                         help="fleet width for --scenario fleet/decode; "
+                         "worker-process count for --scenario host "
                          "(default 3)")
     chaos_p.set_defaults(fn=cmd_chaos)
 

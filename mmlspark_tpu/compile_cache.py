@@ -73,6 +73,21 @@ def cache_dir() -> str:
     return str(mmlconfig.get("runtime.compile_cache_dir") or "")
 
 
+def worker_env(root: Optional[str] = None) -> Dict[str, str]:
+    """Environment exports that point a CHILD process at the same
+    persistent cache. The process-fleet supervisor spawns each replica
+    with this merged into its environment, so replica N+1 (and every warm
+    restart) cold-starts by LOADING the programs replica N stored —
+    multi-reader is safe by construction here: entries publish via
+    tmp-file + ``os.replace`` and are sha256-verified on load, so a
+    concurrent writer loses the race harmlessly and a reader never
+    observes a torn file. Returns ``{}`` when caching is off."""
+    root = cache_dir() if root is None else str(root or "")
+    if not root:
+        return {}
+    return {"MMLSPARK_TPU_RUNTIME_COMPILE_CACHE_DIR": os.path.abspath(root)}
+
+
 def enable_from_config() -> Optional[str]:
     """Wire ``jax_compilation_cache_dir`` from ``runtime.compile_cache_dir``
     for all jit paths. Returns the directory when enabled, None when the

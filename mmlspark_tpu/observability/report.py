@@ -57,7 +57,9 @@ from mmlspark_tpu.utils.logging import get_logger
 
 def load_events(path: str) -> List[Dict[str, Any]]:
     """Parse a JSON-lines event log; malformed lines are counted and
-    skipped (a crash mid-write may truncate the final line), not fatal."""
+    skipped (a SIGKILLed process tears its final line mid-write), not
+    fatal. Every skipped line increments the ``events.torn_lines``
+    counter so a merged fleet view quantifies its own data loss."""
     events: List[Dict[str, Any]] = []
     bad = 0
     with open(path, encoding="utf-8") as f:
@@ -70,8 +72,9 @@ def load_events(path: str) -> List[Dict[str, Any]]:
             except json.JSONDecodeError:
                 bad += 1
     if bad:
+        metrics.counter("events.torn_lines").inc(bad)
         get_logger("observability.report").warning(
-            "%s: skipped %d malformed line(s)", path, bad)
+            "%s: skipped %d torn/malformed line(s)", path, bad)
     return events
 
 
@@ -354,7 +357,7 @@ def build_report(path, top: int = 10,
                 by_ten[e.get("tenant", "?")] += 1
             fl["tenant_throttled"] = dict(sorted(by_ten.items()))
         killed = [e.get("replica", "?") for e in fleet_ev
-                  if e.get("name") == "replica_killed"]
+                  if e.get("name") in ("kill", "replica_killed")]
         if killed:
             fl["replicas_killed"] = killed
         if rollout_ev:
@@ -374,6 +377,47 @@ def build_report(path, top: int = 10,
                     ro["status"] = f"aborted@{e.get('replica', '?')}"
             fl["rollouts"] = list(by_target.values())
         report["fleet"] = fl
+
+    # -- supervisor (process-fleet restart decisions) ----------------------
+    sup_ev = [e for e in events if e.get("type") == "supervisor"]
+    if sup_ev:
+        sup: Dict[str, Any] = {}
+        spawns = [e for e in sup_ev if e.get("name") == "spawn"]
+        restarts = [e for e in sup_ev if e.get("name") == "restart"]
+        backoffs = [e for e in sup_ev if e.get("name") == "backoff"]
+        giveups = [e for e in sup_ev if e.get("name") == "giveup"]
+        exits = [e for e in sup_ev if e.get("name") == "exit"]
+        by_rep: Dict[str, Dict[str, int]] = defaultdict(
+            lambda: {"spawns": 0, "restarts": 0, "backoffs": 0,
+                     "giveups": 0})
+        for name, evs in (("spawns", spawns), ("restarts", restarts),
+                          ("backoffs", backoffs), ("giveups", giveups)):
+            for e in evs:
+                by_rep[str(e.get("replica", "?"))][name] += 1
+        sup["spawns"] = len(spawns)
+        sup["restarts"] = len(restarts)
+        sup["backoffs"] = len(backoffs)
+        sup["giveups"] = len(giveups)
+        sup["by_replica"] = {k: dict(v)
+                             for k, v in sorted(by_rep.items())}
+        sup["worker_pids"] = sorted(
+            {int(e["pid"]) for e in spawns
+             if e.get("pid") is not None})
+        if exits:
+            sup["exits"] = [
+                {"replica": e.get("replica", "?"), "pid": e.get("pid"),
+                 "returncode": e.get("returncode"),
+                 "uptime_s": e.get("uptime_s")}
+                for e in exits]
+        if restarts:
+            sup["restart_ready_s_max"] = max(
+                float(e.get("ready_s", 0.0)) for e in restarts)
+        shut = [e for e in sup_ev if e.get("name") == "shutdown"]
+        if shut:
+            sup["shutdowns"] = [
+                {"reason": e.get("reason", "?"),
+                 "workers": e.get("workers")} for e in shut]
+        report["supervisor"] = sup
 
     # -- SLO burn/breach (slo.* events from the burn-rate engine) ----------
     slo_ev = [e for e in events if e.get("type") == "slo"]
@@ -653,6 +697,29 @@ def render_report(path, top: int = 10) -> str:
                 f"  rollout {ro['model']} -> {ro['version']}: "
                 f"{ro['shifted']} replica(s) shifted, "
                 f"{ro['warmed']} warmed, {ro['status']}")
+        out.append("")
+
+    if "supervisor" in r:
+        sup = r["supervisor"]
+        out.append("supervisor:")
+        detail = ", ".join(
+            f"{k}: {v['spawns']} spawn(s), {v['restarts']} restart(s), "
+            f"{v['backoffs']} backoff(s), {v['giveups']} giveup(s)"
+            for k, v in sup["by_replica"].items())
+        out.append(f"  replicas: {detail}")
+        out.append(
+            f"  worker pids: "
+            f"{', '.join(str(p) for p in sup['worker_pids']) or '-'}")
+        for e in sup.get("exits", ()):
+            out.append(
+                f"  exit: {e['replica']} pid={e['pid']} "
+                f"rc={e['returncode']} after {e['uptime_s']}s")
+        if "restart_ready_s_max" in sup:
+            out.append(f"  slowest restart to ready: "
+                       f"{sup['restart_ready_s_max']:.2f}s")
+        for s in sup.get("shutdowns", ()):
+            out.append(f"  shutdown ({s['reason']}): "
+                       f"{s['workers']} worker(s) drained")
         out.append("")
 
     if "slo" in r:

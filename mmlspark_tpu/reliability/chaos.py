@@ -48,6 +48,21 @@ _LOG = get_logger("reliability.chaos")
 
 VERDICT_FILE = "chaos_verdict.json"
 
+# Registered scenarios (name -> one-line description). The CLI dispatches
+# through this registry; an unknown --scenario prints it and exits 2
+# instead of tracebacking.
+SCENARIOS: Dict[str, str] = {
+    "train": "kill+resume training to bit-identical params, then serve "
+             "under injected faults",
+    "fleet": "kill one in-process replica of an N-wide fleet under fire; "
+             "zero dropped requests, scores bit-identical",
+    "decode": "kill a replica mid-generation; every sequence completes "
+              "via failover-restart with bit-identical tokens",
+    "host": "SIGKILL a real worker PROCESS under fire; supervisor "
+            "warm-restarts it from the shared compile cache, and a "
+            "crash-looper ends breaker-open, not flapping",
+}
+
 # Sites the TRAIN phase draws its schedule from. `trainer.train_step` /
 # `checkpoint.save` raises are kills (the loop restarts); a
 # `checkpoint.restore` raise poisons the newest checkpoint ONCE, forcing
@@ -720,6 +735,312 @@ def run_decode_scenario(seed: int, outdir: str, replicas: int = 2,
         from mmlspark_tpu.observability import flightrec
         dumped = flightrec.dump(
             reason=f"chaos.decode.red.seed{seed}",
+            path=os.path.join(outdir, "chaos_flightrec.jsonl"))
+        if dumped:
+            _LOG.error("chaos: flight recorder dumped to %s", dumped)
+    return verdict
+
+
+# -- host scenario -----------------------------------------------------------
+
+class _DeadHandle:
+    """Fake worker handle that is already dead at birth: the crash-loop
+    stimulus for the supervisor's breaker hysteresis (phase B of the host
+    scenario). Satisfies the duck-typed handle protocol."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.addr = ""
+
+    def poll(self) -> int:
+        return 1
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        return 1
+
+    def terminate(self) -> None:
+        pass
+
+    def kill(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class _CrashSpawner:
+    """Spawner whose every child dies instantly; counts spawns so the
+    no-flapping invariant is a plain integer comparison."""
+
+    def __init__(self) -> None:
+        self.spawns = 0
+
+    def spawn(self, name: str) -> _DeadHandle:
+        self.spawns += 1
+        return _DeadHandle(40_000 + self.spawns)
+
+
+def run_host_scenario(seed: int, outdir: str, replicas: int = 2,
+                      requests: int = 12) -> Dict[str, Any]:
+    """SIGKILL a worker PROCESS under fire; the fleet rides it out warm.
+
+    Unlike the ``fleet`` scenario (in-process replicas, simulated kill),
+    every replica here is a real ``mmlspark-tpu serve`` OS process behind
+    the :class:`~mmlspark_tpu.serve.supervisor.Supervisor` — the kill is
+    a real ``SIGKILL`` (no drain, no goodbye, a torn final event-log
+    line), and the restart is a real process cold-start that must come
+    back WARM from the shared compile cache.
+
+    **Phase A (real processes):** spawn ``replicas`` workers over a
+    shared ``runtime.compile_cache_dir`` and a shared per-pid-sidecar
+    events dir; drive a seeded request stream through the Router (client
+    retries ride out the failover window); at the seeded ``kill_at`` the
+    seeded victim is SIGKILLed; the supervisor backs off, respawns it,
+    and re-registers it into rotation; the harness then scores directly
+    on the restarted replica and scrapes its ``/metrics`` for
+    ``compile_cache_hits``.
+
+    **Phase B (crash-loop hysteresis, virtual clock):** a fake spawner
+    whose children die at birth drives the SAME supervisor state machine
+    under an injected clock: enough consecutive crashes trip the breaker
+    OPEN, the cooldown admits exactly ONE half-open probe respawn, and
+    the probe's crash re-opens — restart *flapping* is structurally
+    impossible, and the whole phase is deterministic.
+
+    Invariants (verdict JSON, ``outdir/chaos_verdict.json``):
+
+    - ``zero_failed_requests``     — every streamed request scored
+      despite the kill (failover + client retry absorbed the window);
+    - ``warm_restart``             — the RESTARTED process reports
+      ``compile_cache_hits > 0``: it loaded programs, didn't compile;
+    - ``restart_observed``         — the victim really respawned (new
+      pid, same replica name, back in rotation);
+    - ``supervisor_events``        — the merged per-pid sidecars carry
+      the supervisor's ``spawn``/``exit``/``backoff``/``restart``
+      decisions;
+    - ``merged_report_coherent``   — one ``build_report`` over all
+      sidecars yields a supervisor section whose distinct worker pids
+      cover the initial fleet AND the restart;
+    - ``crash_loop_breaker_open``  — phase B ends breaker-open, the
+      crash-looper held OUT of rotation;
+    - ``no_restart_flapping``      — total phase-B spawns ==
+      ``breaker_failures + 1`` (the closed-state attempts plus exactly
+      one half-open probe) and the cooldown window spawned nothing.
+
+    The ``schedule`` (kill point, victim) is a pure function of ``seed``.
+    """
+    import time as _time
+    import urllib.request
+
+    import numpy as np
+
+    from mmlspark_tpu.observability.aggregate import (expand_event_paths,
+                                                      merge_event_logs,
+                                                      parse_prometheus_text)
+    from mmlspark_tpu.observability.report import build_report
+    from mmlspark_tpu.reliability.retry import RetryPolicy
+    from mmlspark_tpu.serve.router import Router
+    from mmlspark_tpu.serve.supervisor import ProcessSpawner, Supervisor
+    from mmlspark_tpu.utils import config as mmlconfig
+
+    os.makedirs(outdir, exist_ok=True)
+    events_dir = os.path.join(outdir, "events")
+    cache_dir = os.path.join(outdir, "compile-cache")
+    os.makedirs(events_dir, exist_ok=True)
+    os.makedirs(cache_dir, exist_ok=True)
+    errors: List[str] = []
+    verdict: Dict[str, Any] = {"seed": seed, "scenario": "host",
+                               "replicas": replicas, "requests": requests}
+
+    rng = random.Random(seed ^ 0x4057)
+    kill_at = rng.randint(max(1, requests // 3), max(1, (2 * requests) // 3))
+    kill_idx = rng.randrange(replicas)
+    kill_name = f"w{kill_idx}"
+    verdict["schedule"] = {"kill_at": kill_at, "kill_replica": kill_name}
+
+    model_spec = json.dumps({"input_dim": _DIM, "hidden": [16],
+                             "num_classes": 3, "seed": seed & 0xFFFF})
+    model_flag = f"chaos=mlp_tabular:{model_spec}"
+
+    # the chaos/supervisor process writes its OWN per-pid sidecar next to
+    # the workers' so supervisor.* decisions land in the merged view
+    prior_events = mmlconfig.get("observability.events_path")
+    mmlconfig.set("observability.events_path",
+                  os.path.join(events_dir, f"events-{os.getpid()}.jsonl"))
+
+    names = [f"w{i}" for i in range(replicas)]
+    spawner = ProcessSpawner([model_flag], events_dir=events_dir,
+                             compile_cache_dir=cache_dir,
+                             extra_args=["--max-batch", "4",
+                                         "--queue-depth", "32"])
+    # tight supervision: a SIGKILLed worker respawns within ~50 ms of the
+    # reap, and half a second of uptime confirms the incarnation healthy
+    sup = Supervisor(spawner, names, min_uptime_s=0.5, base_delay_s=0.05,
+                     max_delay_s=0.5, breaker_failures=3,
+                     breaker_reset_s=30.0)
+    client = RetryPolicy(max_attempts=6, base_delay=0.2, max_delay=2.0,
+                         jitter=0.0, name="chaos.host.client", seed=seed)
+    xrng = np.random.default_rng(seed)
+    stream = [xrng.normal(0, 1, (2, _DIM)).astype(np.float32)
+              for _ in range(requests)]
+
+    served = 0
+    failed = 0
+    killed_pid: Optional[int] = None
+    cache_hits = -1.0
+    restart_stats: Dict[str, Any] = {}
+    router = None
+    try:
+        sup.start()
+        down = [n for n, s in sup.stats().items() if not s["running"]]
+        if down:
+            raise ChaosError(f"workers failed to start: {down} "
+                             f"(see {events_dir}/worker-*.log)")
+        router = Router(sup.replicas, failover_attempts=replicas + 1)
+        sup.attach_router(router)
+        router.probe()
+        sup.start_monitor(0.05)
+        for i, x in enumerate(stream):
+            if i == kill_at:
+                killed_pid = sup.kill_replica(kill_name)
+                if killed_pid is None:
+                    errors.append("kill landed on a slot with no live "
+                                  "process")
+            try:
+                y = np.asarray(client.call(router.submit, "chaos", x))
+                if y.shape[0] == 2:
+                    served += 1
+                else:
+                    failed += 1
+                    errors.append(f"request {i}: wrong shape {y.shape}")
+            except Exception as e:
+                failed += 1
+                errors.append(f"request {i}: {type(e).__name__}: {e}")
+        # wait for the warm restart (respawn is ~50 ms after the reap; the
+        # child's cold-start — imports + cache loads — dominates)
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline:
+            st = sup.stats()[kill_name]
+            # ready_spawns (not spawns) is the gate: the respawned pid is
+            # alive long before it binds, and only _on_ready guarantees
+            # the replica's addr points at the NEW incarnation
+            if st["running"] and st["ready_spawns"] >= 2:
+                restart_stats = dict(st)
+                break
+            _time.sleep(0.1)
+        if not restart_stats:
+            errors.append("killed replica never came back ready")
+        else:
+            # score directly on the RESTARTED process (forces its lazy
+            # program build), then read its own /metrics: a warm restart
+            # LOADED compiled programs from the shared cache
+            rep = sup.replica(kill_name)
+            y = np.asarray(rep.submit("chaos", stream[kill_at]))
+            if y.shape[0] != 2:
+                errors.append(f"restarted replica: wrong shape {y.shape}")
+            with urllib.request.urlopen(f"{rep.addr}/metrics",
+                                        timeout=10) as resp:
+                parsed = parse_prometheus_text(resp.read().decode())
+            cache_hits = float(
+                parsed.get("compile_cache_hits", {}).get("value", 0.0))
+    except Exception as e:
+        errors.append(f"host scenario: {type(e).__name__}: {e}")
+    finally:
+        if router is not None:
+            try:
+                router.close()
+            except Exception as e:
+                _LOG.debug("router close failed: %s", e)
+        sup.shutdown(reason="chaos host scenario complete")
+
+    verdict["schedule"]["killed_pid"] = killed_pid
+    verdict["host"] = {"served": served, "failed": failed,
+                       "restart": restart_stats,
+                       "compile_cache_hits": cache_hits,
+                       "events_dir": events_dir}
+
+    # merge every per-pid sidecar (workers + supervisor) into ONE view;
+    # the SIGKILLed worker's torn final line must be skipped, not fatal
+    paths = expand_event_paths(
+        [], os.path.join(events_dir, "events-*.jsonl"))
+    merged = merge_event_logs(paths)
+    sup_event_names = {e.get("name") for e in merged
+                       if e.get("type") == "supervisor"}
+    report = build_report(paths) if paths else {}
+    rep_sup = report.get("supervisor", {}) if isinstance(report, dict) \
+        else {}
+    worker_pids = rep_sup.get("worker_pids", [])
+    coherent = (bool(rep_sup)
+                and len(set(worker_pids)) >= replicas + 1
+                and rep_sup.get("restarts", 0) >= 1)
+    verdict["host"]["sidecars"] = len(paths)
+    verdict["host"]["supervisor_event_names"] = sorted(
+        n for n in sup_event_names if n)
+
+    # phase B: crash-loop hysteresis on a virtual clock (deterministic)
+    vt = {"t": 0.0}
+    crash = _CrashSpawner()
+    sup2 = Supervisor(crash, ["cl0"], min_uptime_s=5.0, base_delay_s=1.0,
+                      max_delay_s=8.0, ready_timeout_s=1.0,
+                      breaker_failures=3, breaker_reset_s=60.0,
+                      clock=lambda: vt["t"],
+                      sleep=lambda s: vt.__setitem__("t", vt["t"] + s))
+    sup2.start()
+    opened_at: Optional[float] = None
+    spawns_at_open = 0
+    spawn_trace: List[Any] = []
+    for _ in range(200):
+        sup2.poll_once()
+        state = sup2.breaker_state("cl0")
+        spawn_trace.append((vt["t"], crash.spawns, state))
+        if opened_at is None and state == "open":
+            opened_at = vt["t"]
+            spawns_at_open = crash.spawns
+        vt["t"] += 1.0
+        if opened_at is not None and vt["t"] > opened_at + 75.0:
+            break
+    sup2.shutdown(reason="chaos host phase B complete")
+    final_state = sup2.breaker_state("cl0")
+    cooldown_spawns = [s for t, s, _ in spawn_trace
+                       if opened_at is not None
+                       and opened_at <= t < opened_at + 59.0]
+    no_spawn_in_cooldown = bool(cooldown_spawns) \
+        and max(cooldown_spawns) == spawns_at_open
+    verdict["crash_loop"] = {
+        "spawns": crash.spawns, "opened_at": opened_at,
+        "spawns_at_open": spawns_at_open, "final_breaker": final_state,
+    }
+
+    invariants = {
+        "zero_failed_requests": failed == 0 and served == requests,
+        "warm_restart": cache_hits > 0,
+        "restart_observed": bool(restart_stats),
+        "supervisor_events": {"spawn", "exit", "backoff",
+                              "restart"} <= sup_event_names,
+        "merged_report_coherent": coherent,
+        "crash_loop_breaker_open": final_state == "open",
+        "no_restart_flapping": (crash.spawns == 3 + 1
+                                and no_spawn_in_cooldown),
+        "no_unhandled_exceptions": not errors,
+    }
+    verdict["invariants"] = invariants
+    verdict["errors"] = errors
+    verdict["passed"] = all(invariants.values())
+
+    # restore the prior event sink AFTER the verdict facts are gathered
+    mmlconfig.set("observability.events_path", prior_events)
+
+    path = os.path.join(outdir, VERDICT_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(verdict, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    _LOG.info("chaos host verdict (%s): %s", path,
+              "PASS" if verdict["passed"] else "FAIL")
+    if not verdict["passed"]:
+        from mmlspark_tpu.observability import flightrec
+        dumped = flightrec.dump(
+            reason=f"chaos.host.red.seed{seed}",
             path=os.path.join(outdir, "chaos_flightrec.jsonl"))
         if dumped:
             _LOG.error("chaos: flight recorder dumped to %s", dumped)

@@ -80,6 +80,14 @@ the occupancy gauges stop summing. Size arithmetic goes through
 ``memory.nbytes_of`` / ``memory.param_bytes``; deliberate exceptions
 mark the line ``# lint: allow-bytes``.
 
+Rule 12 — process management (``subprocess.Popen(...)``, ``os.kill(...)``,
+``os.waitpid(...)``) outside ``serve/supervisor.py``: child processes
+need exactly one owner — a worker spawned (or signalled) from some corner
+of the library is invisible to the supervisor's restart/backoff/breaker
+machinery and its drain path, so it leaks on shutdown and double-restarts
+under chaos. All process lifecycle goes through the supervisor;
+deliberate exceptions mark the line ``# lint: allow-process``.
+
 Shared core for ``tools/check_reliability.py`` (standalone CLI),
 ``mmlspark-tpu check`` (installed CLI), and the in-pytest gate
 (tests/test_reliability_lint.py) — same single source of truth pattern as
@@ -149,6 +157,10 @@ _ALLOW_BYTES = "# lint: allow-bytes"
 # the ONE module allowed to do device-byte arithmetic (it IS the ledger)
 _BYTES_HOME = "observability/memory.py"
 _BYTES_ATTRS = ("nbytes", "itemsize")
+_ALLOW_PROCESS = "# lint: allow-process"
+# the ONE module allowed to manage OS processes (it IS the supervisor)
+_PROCESS_HOME = "serve/supervisor.py"
+_PROCESS_OS_CALLS = ("kill", "waitpid")
 
 
 def _is_raw_sync(call: ast.Call) -> bool:
@@ -227,6 +239,21 @@ def _is_device_alloc(call: ast.Call) -> bool:
     return isinstance(f, ast.Name) and f.id == "device_put"
 
 
+def _is_process_call(call: ast.Call) -> bool:
+    """``subprocess.Popen(...)`` (any receiver, or a bare ``Popen(...)``
+    name call) plus ``os.kill(...)`` / ``os.waitpid(...)`` — process
+    lifecycle management. The ``os.``-receiver restriction mirrors
+    :func:`_is_signal_signal`: ``proc.kill()`` / ``replica.kill()`` are
+    object methods with their own contracts, not the raw syscall."""
+    f = call.func
+    if isinstance(f, ast.Name) and f.id == "Popen":
+        return True
+    if isinstance(f, ast.Attribute) and f.attr == "Popen":
+        return True
+    return (isinstance(f, ast.Attribute) and f.attr in _PROCESS_OS_CALLS
+            and isinstance(f.value, ast.Name) and f.value.id == "os")
+
+
 def _is_signal_signal(call: ast.Call) -> bool:
     """``signal.signal(...)`` (or any ``<x>.signal(...)`` attribute call on
     a name ending in ``signal``) — the handler-installation form. A bare
@@ -253,6 +280,8 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     alloc_scoped = "serve/" in norm and not norm.endswith(_ALLOC_HOME)
     # Rule 11 scope: serve/ modules only (the ledger home is outside it)
     bytes_scoped = "serve/" in norm and not norm.endswith(_BYTES_HOME)
+    # Rule 12 scope: everywhere, the supervisor exempt (it IS the owner)
+    process_home = norm.endswith(_PROCESS_HOME)
 
     def _allowed(lineno: int) -> bool:
         # marker anywhere on the offending line opts that line out
@@ -282,6 +311,10 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     def _bytes_allowed(lineno: int) -> bool:
         return (0 < lineno <= len(lines)
                 and _ALLOW_BYTES in lines[lineno - 1])
+
+    def _process_allowed(lineno: int) -> bool:
+        return (0 < lineno <= len(lines)
+                and _ALLOW_PROCESS in lines[lineno - 1])
 
     for node in ast.walk(tree):
         if (isinstance(node, ast.Call)
@@ -365,6 +398,15 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
                 "size formulas drift from the HBM ledger's; route through "
                 "memory.nbytes_of/memory.param_bytes, or mark the line "
                 f"`{_ALLOW_BYTES}`)")
+        elif (isinstance(node, ast.Call) and _is_process_call(node)
+                and not process_home
+                and not _process_allowed(node.lineno)):
+            problems.append(
+                f"{filename}:{node.lineno}: process management "
+                f"(Popen/os.kill/os.waitpid) outside {_PROCESS_HOME} "
+                "(workers need ONE owner — the supervisor's restart/"
+                "drain machinery; route through serve.supervisor, or "
+                f"mark the line `{_ALLOW_PROCESS}`)")
         elif (isinstance(node, ast.Call) and _is_raw_sync(node)
                 and not sync_home
                 and not _sync_allowed(node.lineno)):

@@ -27,6 +27,9 @@ from mmlspark_tpu.serve.router import (        # noqa: F401
 from mmlspark_tpu.serve.server import (        # noqa: F401
     RequestExpired, ServeError, Server, ServerClosed, ServerOverloaded,
 )
+from mmlspark_tpu.serve.supervisor import (    # noqa: F401
+    ProcessSpawner, Supervisor,
+)
 
 __all__ = [
     "MicroBatcher", "Ticket", "bucket_for", "default_buckets",
@@ -36,4 +39,5 @@ __all__ = [
     "ReplicaUnavailable", "TenantThrottled", "WeightedFairAdmission",
     "ContinuousBatcher", "GenerateLane", "GenerateRequest",
     "GenerativeEntry", "KVCacheManager", "blocks_needed",
+    "Supervisor", "ProcessSpawner",
 ]

@@ -118,13 +118,15 @@ class InProcessReplica:
 
     def kill(self) -> None:
         """Die like a preempted pod: no drain, in-flight tickets fail
-        retryably ("retry elsewhere"), health goes dead. Idempotent."""
+        retryably ("retry elsewhere"), health goes dead. Idempotent by
+        contract — the host chaos scenario double-kills under race, so a
+        second kill is a silent no-op (no error, no duplicate event)."""
         if self._dead:
             return
         self._dead = True
         logger.warning("replica %s killed", self.name)
         if events.recording_enabled():
-            events.emit("fleet", "replica_killed", replica=self.name)
+            events.emit("fleet", "kill", replica=self.name)
         self.server.close(drain=False, timeout_s=0.5)
 
 
@@ -183,7 +185,9 @@ class Fleet:
 
     def kill(self, index: int) -> None:
         """Chaos lever: kill replica ``index`` without telling the router
-        — failover and health probing must DISCOVER the death."""
+        — failover and health probing must DISCOVER the death. Idempotent
+        like the replica-level kill: double-killing the same index under
+        a chaos race is a no-op, not an error."""
         self.replicas[index].kill()
 
     # -- rolling rollout ----------------------------------------------------

@@ -307,6 +307,14 @@ class Router:
         if events.recording_enabled():
             events.emit("fleet", "weight", replica=name, weight=weight)
 
+    def reset_breaker(self, name: str) -> None:
+        """Force-close one replica's breaker (operator/supervisor lever).
+        A replica that was DOWN long enough to trip its breaker open and
+        then came back healthy would otherwise wait out the full cooldown
+        before taking traffic; the supervisor calls this on a verified
+        warm restart so re-registration is immediate."""
+        self._handles[name].breaker.reset()
+
     def _pick(self, exclude: frozenset) -> Optional[_Handle]:
         """Smooth weighted round-robin over ready, positive-weight,
         non-excluded replicas. Deterministic: same weights + same call
@@ -742,7 +750,44 @@ class HttpReplica:
 
     def models(self) -> List[str]:
         import json as _json
+        import urllib.error
         import urllib.request
-        with urllib.request.urlopen(
-                f"{self.addr}/models", timeout=self.timeout_s) as resp:
-            return list(_json.loads(resp.read().decode("utf-8"))["models"])
+        try:
+            with urllib.request.urlopen(
+                    f"{self.addr}/models", timeout=self.timeout_s) as resp:
+                return list(
+                    _json.loads(resp.read().decode("utf-8"))["models"])
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise ReplicaUnavailable(
+                f"replica {self.name} unreachable: {e}") from None
+
+    def _probe(self, endpoint: str) -> bool:
+        """GET a liveness-style endpoint with the replica timeout. 200 is
+        True, a 503 answer is False (the endpoint's not-yet contract), and
+        a transport failure — connection refused mid-restart, torn socket,
+        timeout — raises retryable :class:`ReplicaUnavailable` instead of
+        leaking a raw ``URLError`` into the prober thread."""
+        import urllib.error
+        import urllib.request
+        try:
+            with urllib.request.urlopen(
+                    f"{self.addr}{endpoint}", timeout=self.timeout_s):
+                return True
+        except urllib.error.HTTPError as e:
+            if e.code == 503:
+                return False
+            raise ReplicaUnavailable(
+                f"replica {self.name} {endpoint} HTTP {e.code}") from None
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise ReplicaUnavailable(
+                f"replica {self.name} unreachable on {endpoint}: {e}"
+            ) from None
+
+    def probe_livez(self) -> bool:
+        """Remote ``/livez``: True iff the process answers 200."""
+        return self._probe("/livez")
+
+    def probe_readyz(self) -> bool:
+        """Remote ``/readyz``: True iff the replica is admitting traffic
+        (a draining or warming replica answers 503 -> False)."""
+        return self._probe("/readyz")

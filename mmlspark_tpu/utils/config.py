@@ -97,6 +97,19 @@ _DEFAULTS: Dict[str, Any] = {
     "fleet.tenant_weights": "",       # "gold=3,free=1"; unlisted tenants
                                       # get fleet.tenant_default_weight
     "fleet.tenant_default_weight": 1.0,
+    # process-fleet supervisor (serve/supervisor.py — real worker
+    # processes with restart-on-crash; see docs/SERVING.md runbook)
+    "fleet.supervisor_min_uptime_s": 5.0,   # a child dying sooner counts
+                                            # as a crash-loop failure
+    "fleet.supervisor_base_delay_s": 0.5,   # first restart backoff
+    "fleet.supervisor_max_delay_s": 30.0,   # restart backoff cap
+    "fleet.supervisor_ready_timeout_s": 120.0,  # spawn -> ready budget
+                                                # (includes child imports)
+    "fleet.supervisor_breaker_failures": 3,  # consecutive short-lived
+                                             # crashes -> breaker open,
+                                             # replica out of rotation
+    "fleet.supervisor_breaker_reset_s": 60.0,  # open -> one probe respawn
+    "fleet.supervisor_poll_s": 0.2,          # monitor thread cadence
     # logging
     "logging.level": "INFO",
     "logging.metrics_every": 0,       # default train-metric log cadence (steps)

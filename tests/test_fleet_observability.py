@@ -614,3 +614,34 @@ def test_cli_top_requires_replicas():
     from mmlspark_tpu.cli import main
     with pytest.raises(SystemExit):
         main(["top", "--once"])
+
+
+def test_merge_tolerates_torn_final_line(tmp_path):
+    """A SIGKILLed worker tears its last event mid-write: the merge must
+    keep every intact line, skip the torn one, and count the loss."""
+    p = tmp_path / "ev-300.jsonl"
+    _write_events(p, 300, [
+        ("span", "Fit", {"dur_ms": 5.0}),
+        ("span", "Score", {"dur_ms": 3.0}),
+    ])
+    with open(p, "a") as f:
+        f.write('{"ts": 102.0, "pid": 300, "type": "serv')  # no newline
+    merged = merge_event_logs([str(p)])
+    assert [e["name"] for e in merged] == ["Fit", "Score"]
+    assert metrics.counter("events.torn_lines").value == 1
+
+
+def test_merge_torn_lines_counter_accumulates_across_logs(tmp_path):
+    p1, p2 = tmp_path / "ev-1.jsonl", tmp_path / "ev-2.jsonl"
+    _write_events(p1, 1, [("span", "A", {"dur_ms": 1.0})])
+    with open(p1, "a") as f:
+        f.write("{torn")
+    _write_events(p2, 2, [("span", "B", {"dur_ms": 1.0})])
+    with open(p2, "a") as f:
+        f.write('{"ts": 1')
+    merged = merge_event_logs([str(p1), str(p2)])
+    assert len(merged) == 2
+    assert metrics.counter("events.torn_lines").value == 2
+    # and a report built over torn logs still comes out coherent
+    rep = build_report([str(p1), str(p2)])
+    assert rep["events"] == 2

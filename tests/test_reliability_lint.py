@@ -498,3 +498,70 @@ def test_bytes_rule_marker_and_delegation_spelling():
         def delegated(shape, dt):
             return devmem.nbytes_of(shape, dt)
     """), filename="mmlspark_tpu/serve/kvcache.py") == []
+
+
+# -- Rule 12: process management stays inside the supervisor ------------------
+
+def test_process_rule_flags_popen_and_os_kill():
+    src = textwrap.dedent("""
+        import os
+        import signal
+        import subprocess
+
+        def rogue(argv, pid):
+            p = subprocess.Popen(argv)
+            os.kill(pid, signal.SIGKILL)
+            os.waitpid(pid, 0)
+            return p
+    """)
+    probs = lint.check_source(
+        src, filename="mmlspark_tpu/serve/router.py")
+    assert len(probs) == 3
+    assert all("process management" in p for p in probs)
+    assert "allow-process" in probs[0]          # the escape hatch is named
+    assert "serve/supervisor.py" in probs[0]    # and the sanctioned home
+
+
+def test_process_rule_flags_bare_popen_everywhere():
+    # the rule is repo-wide, not serve/-scoped: a featurizer forking
+    # workers behind the supervisor's back is exactly the bug
+    src = textwrap.dedent("""
+        from subprocess import Popen
+
+        def sidecar(argv):
+            return Popen(argv)
+    """)
+    probs = lint.check_source(
+        src, filename="mmlspark_tpu/featurize/image.py")
+    assert len(probs) == 1 and "process management" in probs[0]
+
+
+def test_process_rule_home_exempt():
+    src = textwrap.dedent("""
+        import os
+        import subprocess
+
+        def spawn(argv, pid):
+            os.kill(pid, 9)
+            return subprocess.Popen(argv)
+    """)
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/serve/supervisor.py") == []
+    # path normalization: Windows separators still match the home
+    assert lint.check_source(
+        src, filename="C:\\x\\mmlspark_tpu\\serve\\supervisor.py") == []
+
+
+def test_process_rule_marker_and_non_os_receivers():
+    assert lint.check_source(textwrap.dedent("""
+        import os
+        import subprocess
+
+        def sanctioned(argv, pid, proc, replica):
+            p = subprocess.Popen(argv)  # lint: allow-process
+            os.kill(pid, 9)  # lint: allow-process
+            proc.kill()           # handle method, not os.kill
+            replica.kill()        # Fleet chaos lever, not a process op
+            subprocess.run(argv)  # run() is not Popen
+            return p
+    """), filename="mmlspark_tpu/reliability/chaos.py") == []

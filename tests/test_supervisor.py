@@ -1,0 +1,405 @@
+"""Supervisor restart state machine under a virtual clock.
+
+Every test drives :meth:`Supervisor.poll_once` by hand with injected
+``clock``/``sleep`` and fake spawners/handles — no real process is ever
+forked here (that's ``test_cli.py``'s fleet smoke and the host chaos
+scenario). The hysteresis tests pin the no-flapping contract: a
+crash-looper trips its breaker OPEN, spawns NOTHING during the cooldown,
+gets exactly ONE half-open probe respawn, and a probe crash re-opens.
+"""
+import json
+import os
+
+import pytest
+
+from mmlspark_tpu.observability import events
+from mmlspark_tpu.serve.supervisor import ProcessSpawner, Supervisor
+from mmlspark_tpu.utils import config as mmlconfig
+
+
+class VClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += float(s)
+
+
+class FakeHandle:
+    """A worker handle whose death the test scripts explicitly."""
+
+    def __init__(self, pid, addr):
+        self.pid = pid
+        self.addr = addr
+        self.rc = None
+        self.terminated = False
+        self.killed = False
+        self.closed = False
+
+    def await_announce(self, timeout):
+        return bool(self.addr)
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.terminated = True
+        if self.rc is None:
+            self.rc = 0          # graceful drain: exits clean
+
+    def kill(self):
+        self.killed = True
+        if self.rc is None:
+            self.rc = -9
+
+    def wait(self, timeout=None):
+        return self.rc
+
+    def close(self):
+        self.closed = True
+
+    def die(self, rc=1):
+        self.rc = rc
+
+
+class FakeSpawner:
+    """Hands out live FakeHandles with distinct pids/ports."""
+
+    def __init__(self):
+        self.count = 0
+        self.handles = {}
+
+    def spawn(self, name):
+        self.count += 1
+        h = FakeHandle(1000 + self.count, f"127.0.0.1:{9000 + self.count}")
+        self.handles.setdefault(name, []).append(h)
+        return h
+
+
+class DeadSpawner:
+    """Every child is dead at birth: the crash-loop stimulus."""
+
+    def __init__(self):
+        self.count = 0
+
+    def spawn(self, name):
+        self.count += 1
+        h = FakeHandle(2000 + self.count, "")
+        h.rc = 1
+        return h
+
+
+class FakeRouter:
+    def __init__(self, names):
+        self.weights = {n: 1.0 for n in names}
+        self.resets = []
+        self.probes = 0
+
+    def set_weight(self, name, w):
+        self.weights[name] = float(w)
+
+    def reset_breaker(self, name):
+        self.resets.append(name)
+
+    def probe(self):
+        self.probes += 1
+        return {}
+
+    def stats(self):
+        return {"replicas": {n: {"weight": w}
+                             for n, w in self.weights.items()}}
+
+
+def make_sup(spawner, names, clock, **kw):
+    kw.setdefault("min_uptime_s", 1.0)
+    kw.setdefault("base_delay_s", 2.0)
+    kw.setdefault("max_delay_s", 8.0)
+    kw.setdefault("ready_timeout_s", 5.0)
+    kw.setdefault("breaker_failures", 3)
+    kw.setdefault("breaker_reset_s", 60.0)
+    kw.setdefault("ready_fn", lambda replica, handle: True)
+    return Supervisor(spawner, names, clock=clock,
+                      sleep=lambda s: clock.advance(s), **kw)
+
+
+def test_start_spawns_all_and_registers_addrs():
+    clock = VClock()
+    sp = FakeSpawner()
+    sup = make_sup(sp, ["a", "b"], clock)
+    sup.start()
+    st = sup.stats()
+    assert st["a"]["running"] and st["b"]["running"]
+    assert st["a"]["spawns"] == 1 and st["b"]["spawns"] == 1
+    # the announce addr lands on the pre-built HttpReplica, normalized
+    assert sup.replica("a").addr == "http://127.0.0.1:9001"
+    assert sup.replica("b").addr == "http://127.0.0.1:9002"
+    assert sup.pid("a") == 1001
+
+
+def test_names_validated():
+    clock = VClock()
+    with pytest.raises(ValueError):
+        make_sup(FakeSpawner(), [], clock)
+    with pytest.raises(ValueError):
+        make_sup(FakeSpawner(), ["a", "a"], clock)
+
+
+def test_crash_backs_off_restarts_and_reregisters(tmp_path):
+    ev_path = tmp_path / "events.jsonl"
+    mmlconfig.set("observability.events_path", str(ev_path))
+    try:
+        clock = VClock()
+        sp = FakeSpawner()
+        sup = make_sup(sp, ["a"], clock)
+        router = FakeRouter(["a"])
+        sup.attach_router(router)
+        sup.start()
+        # survive min_uptime -> incarnation confirmed, breaker success
+        clock.advance(1.5)
+        sup.poll_once()
+        assert sup.stats()["a"]["consecutive_crashes"] == 0
+
+        sp.handles["a"][0].die(3)
+        sup.poll_once()
+        # out of rotation immediately; restart scheduled at +base_delay
+        assert router.weights["a"] == 0.0
+        assert sup.stats()["a"]["running"] is False
+        sup.poll_once()                     # before the backoff expires
+        assert sup.stats()["a"]["spawns"] == 1
+
+        clock.advance(2.0)                  # base_delay
+        sup.poll_once()
+        st = sup.stats()["a"]
+        assert st["running"] and st["spawns"] == 2
+        # re-registered: weight restored, fleet breaker reset, new addr
+        assert router.weights["a"] == 1.0
+        assert router.resets and set(router.resets) == {"a"}
+        assert sup.replica("a").addr == "http://127.0.0.1:9002"
+        assert sup.pid("a") == 1002
+    finally:
+        mmlconfig.unset("observability.events_path")
+        events.close()
+    names = [json.loads(line)["name"] for line in
+             ev_path.read_text().splitlines()
+             if json.loads(line)["type"] == "supervisor"]
+    for expected in ("spawn", "exit", "backoff", "restart"):
+        assert expected in names, f"missing supervisor.{expected}"
+
+
+def test_confirmed_uptime_resets_consecutive_crashes():
+    clock = VClock()
+    sp = FakeSpawner()
+    sup = make_sup(sp, ["a"], clock)
+    sup.start()
+    # two crash/restart rounds WITHOUT confirmation stack up
+    for expected_delay in (2.0, 4.0):
+        sp.handles["a"][-1].die(1)
+        sup.poll_once()
+        clock.advance(expected_delay)
+        sup.poll_once()
+        assert sup.stats()["a"]["running"]
+    assert sup.stats()["a"]["consecutive_crashes"] == 2
+    # surviving min_uptime clears the streak and the breaker
+    clock.advance(1.5)
+    sup.poll_once()
+    st = sup.stats()["a"]
+    assert st["consecutive_crashes"] == 0
+    assert st["breaker"] == "closed"
+    # the next crash starts the backoff ladder from the bottom again
+    sp.handles["a"][-1].die(1)
+    sup.poll_once()
+    clock.advance(1.9)
+    sup.poll_once()
+    assert not sup.stats()["a"]["running"]   # 2.0 s not yet elapsed
+    clock.advance(0.1)
+    sup.poll_once()
+    assert sup.stats()["a"]["running"]
+
+
+def test_crash_loop_opens_breaker_no_flapping():
+    """THE hysteresis contract: threshold crashes -> OPEN -> nothing
+    spawns during the cooldown -> exactly one half-open probe -> a probe
+    crash re-opens with a fresh cooldown."""
+    clock = VClock()
+    sp = DeadSpawner()
+    sup = make_sup(sp, ["a"], clock, ready_fn=lambda r, h: False)
+    sup.start()
+    opened_at = None
+    spawns_at_open = 0
+    trace = []
+    for _ in range(200):
+        sup.poll_once()
+        state = sup.breaker_state("a")
+        trace.append((clock.t, sp.count, state))
+        if opened_at is None and state == "open":
+            opened_at = clock.t
+            spawns_at_open = sp.count
+        clock.advance(1.0)
+        if opened_at is not None and clock.t > opened_at + 75.0:
+            break
+    assert opened_at is not None, "breaker never opened"
+    # it took exactly `breaker_failures` dead spawns to trip
+    assert spawns_at_open == 3
+    # cooldown: NO spawn while the breaker holds the replica out
+    in_cooldown = [s for t, s, _ in trace
+                   if opened_at <= t < opened_at + 59.0]
+    assert in_cooldown and max(in_cooldown) == spawns_at_open
+    # exactly ONE half-open probe respawn, whose crash re-opened
+    assert sp.count == 4
+    assert sup.breaker_state("a") == "open"
+    assert sup.stats()["a"]["breaker"] == "open"
+
+
+def test_shutdown_drains_children_and_stops_restarting():
+    clock = VClock()
+    sp = FakeSpawner()
+    sup = make_sup(sp, ["a", "b"], clock)
+    sup.start()
+    sup.shutdown(reason="test")
+    assert all(h.terminated for hs in sp.handles.values() for h in hs)
+    # closed: no further supervision, no respawns
+    sup.poll_once()
+    assert sp.count == 2
+    sup.shutdown()                           # idempotent
+    assert sp.count == 2
+
+
+def test_shutdown_kills_stragglers_past_drain_budget():
+    clock = VClock()
+
+    class WedgedHandle(FakeHandle):
+        def terminate(self):
+            self.terminated = True           # ignores SIGTERM
+
+        def wait(self, timeout=None):
+            return self.rc                   # None while alive
+
+    class WedgedSpawner(FakeSpawner):
+        def spawn(self, name):
+            self.count += 1
+            h = WedgedHandle(3000 + self.count, "127.0.0.1:9100")
+            self.handles.setdefault(name, []).append(h)
+            return h
+
+    sp = WedgedSpawner()
+    sup = make_sup(sp, ["a"], clock)
+    sup.start()
+    sup.shutdown(drain_timeout_s=0.0)
+    h = sp.handles["a"][0]
+    assert h.terminated and h.killed
+
+
+def test_kill_replica_idempotent():
+    clock = VClock()
+    sp = FakeSpawner()
+    sup = make_sup(sp, ["a"], clock)
+    sup.start()
+    pid = sup.kill_replica("a")
+    assert pid == 1001
+    assert sp.handles["a"][0].killed
+    # second kill on the already-dead slot is a no-op, not an error
+    assert sup.kill_replica("a") is None
+    # after the restart the lever works again on the NEW pid
+    sup.poll_once()
+    clock.advance(2.0)
+    sup.poll_once()
+    assert sup.kill_replica("a") == 1002
+
+
+def test_context_manager_shuts_down():
+    clock = VClock()
+    sp = FakeSpawner()
+    with make_sup(sp, ["a"], clock) as sup:
+        sup.start()
+        assert sup.stats()["a"]["running"]
+    assert sp.handles["a"][0].terminated
+
+
+# -- ProcessSpawner construction (no process spawned) -------------------------
+
+def test_process_spawner_argv_and_env(tmp_path):
+    sp = ProcessSpawner(["m=mlp_tabular:{}"], host="127.0.0.9",
+                        events_dir=str(tmp_path / "ev"),
+                        compile_cache_dir=str(tmp_path / "cache"),
+                        extra_args=["--max-batch", "4"])
+    argv = sp.build_argv("w0")
+    assert argv[1:4] == ["-m", "mmlspark_tpu.cli", "serve"]
+    assert argv[argv.index("--host") + 1] == "127.0.0.9"
+    assert argv[argv.index("--port") + 1] == "0"     # child announces
+    assert argv[argv.index("--model") + 1] == "m=mlp_tabular:{}"
+    assert argv[argv.index("--events-dir") + 1] == str(tmp_path / "ev")
+    assert argv[-2:] == ["--max-batch", "4"]
+    env = sp.build_env()
+    # announce line must cross the pipe unbuffered
+    assert env["PYTHONUNBUFFERED"] == "1"
+    # the shared compile cache rides the env into the child
+    assert env["MMLSPARK_TPU_RUNTIME_COMPILE_CACHE_DIR"] == \
+        os.path.abspath(str(tmp_path / "cache"))
+    # children import the tree the supervisor runs from
+    import mmlspark_tpu
+    pkg_parent = os.path.dirname(os.path.dirname(
+        os.path.abspath(mmlspark_tpu.__file__)))
+    assert env["PYTHONPATH"].split(os.pathsep)[0] == pkg_parent
+
+
+def test_process_spawner_requires_models():
+    with pytest.raises(ValueError):
+        ProcessSpawner([])
+
+
+# -- chaos: scenario registry + host scenario ---------------------------------
+
+def test_chaos_scenario_registry_covers_all_runners():
+    from mmlspark_tpu.reliability import chaos
+    assert set(chaos.SCENARIOS) == {"train", "fleet", "decode", "host"}
+    assert all(desc for desc in chaos.SCENARIOS.values())
+
+
+def test_cli_chaos_unknown_scenario_lists_registry(capsys):
+    from mmlspark_tpu.cli import main
+    assert main(["chaos", "--scenario", "bogus"]) == 2
+    err = capsys.readouterr().err
+    assert "bogus" in err
+    for name in ("train", "fleet", "decode", "host"):
+        assert name in err
+
+
+def test_chaos_host_scenario_green(tmp_path):
+    """ISSUE 11 acceptance: SIGKILL a real worker process under fire ->
+    warm restart (shared compile cache hits), zero failed requests,
+    supervisor events in the merged per-pid report, crash-loop breaker
+    hysteresis — all from one seeded run."""
+    from mmlspark_tpu.reliability import chaos
+    verdict = chaos.run_host_scenario(0, str(tmp_path / "out"),
+                                      replicas=2, requests=6)
+    assert verdict["passed"], verdict
+    inv = verdict["invariants"]
+    assert inv["zero_failed_requests"]
+    assert inv["warm_restart"]            # compile_cache hits > 0 post-kill
+    assert inv["supervisor_events"]
+    assert inv["merged_report_coherent"]
+    assert inv["crash_loop_breaker_open"]
+    assert inv["no_restart_flapping"]
+    # the verdict file is on disk and agrees
+    on_disk = json.loads(
+        (tmp_path / "out" / chaos.VERDICT_FILE).read_text())
+    assert on_disk["passed"] is True
+    assert on_disk["schedule"]["kill_at"] == verdict["schedule"]["kill_at"]
+
+
+@pytest.mark.slow
+def test_chaos_host_schedule_deterministic(tmp_path):
+    """Two same-seed runs draw the same kill point and kill target (pids
+    and wall timings legitimately differ between runs)."""
+    from mmlspark_tpu.reliability import chaos
+    v1 = chaos.run_host_scenario(0, str(tmp_path / "a"),
+                                 replicas=2, requests=6)
+    v2 = chaos.run_host_scenario(0, str(tmp_path / "b"),
+                                 replicas=2, requests=6)
+    assert v1["passed"] and v2["passed"]
+    for key in ("kill_at", "kill_replica"):
+        assert v1["schedule"][key] == v2["schedule"][key]
+    assert v1["crash_loop"] == v2["crash_loop"]   # pure virtual clock
