@@ -7,7 +7,9 @@ one-line JSON result against the committed baseline per lane:
 - ``value`` (the lane's headline throughput) must not drop more than the
   tolerance below the baseline;
 - ``step_ms`` must not rise more than the tolerance above it;
-- ``mfu`` must not drop more than the tolerance below it.
+- ``mfu`` must not drop more than the tolerance below it;
+- ``ttft_p99_ms`` (serving lanes) must not rise more than the tolerance
+  above it.
 
 A lane that was budget-skipped (or terminated) in EITHER run is marked
 ``skipped``, never red — congestion on the bench host must not fail CI.
@@ -103,14 +105,21 @@ def compare(fresh: Dict[str, Any], baseline: Dict[str, Any],
                    _num(base_lane, "step_ms"), tolerance, False),
             _check("mfu", _num(fresh_lane, "mfu"),
                    _num(base_lane, "mfu"), tolerance, True),
+            _check("ttft_p99_ms", _num(fresh_lane, "ttft_p99_ms"),
+                   _num(base_lane, "ttft_p99_ms"), tolerance, False),
         ) if c is not None]
         # compile_ms / cold_start_ms are INFORMATIONAL: cold-start cost
         # swings with cache state and host load, so the comparison is
         # reported (so the compile-cache win is a visible number) but can
-        # never flip a lane red.
-        for info_field in ("compile_ms", "cold_start_ms"):
+        # never flip a lane red. Prefix hit rate and speculative
+        # acceptance are workload signatures, not regressions — reported
+        # so a cache-defeating change is visible, never red.
+        for info_field, higher in (("compile_ms", False),
+                                   ("cold_start_ms", False),
+                                   ("prefix_hit_rate", True),
+                                   ("spec_accept_rate", True)):
             c = _check(info_field, _num(fresh_lane, info_field),
-                       _num(base_lane, info_field), tolerance, False)
+                       _num(base_lane, info_field), tolerance, higher)
             if c is not None:
                 c["ok"] = True
                 c["informational"] = True

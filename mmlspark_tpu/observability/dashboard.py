@@ -2,8 +2,9 @@
 
 A live terminal dashboard over :class:`FleetScraper` + :class:`SloEngine`
 — per-replica ready/draining, queue depth, QPS, p50/p99, shed rate, SLO
-burn, HBM occupancy — for watching a ``Fleet.rollout`` or a chaos run in
-real time. Deliberately curses-free: each frame is a plain string and
+burn, HBM occupancy, and (when a generate lane is live) the decode line:
+prefix-cache hit rate, CoW copies, speculation acceptance, int8 arena
+savings — for watching a ``Fleet.rollout`` or a chaos run in real time. Deliberately curses-free: each frame is a plain string and
 the live loop just re-homes the cursor with ANSI ``ESC[H ESC[J`` before
 printing, so it works over ssh, inside tmux, and in CI logs alike.
 ``--once`` (the :meth:`TopDashboard.run` ``once`` flag) prints a single
@@ -100,6 +101,29 @@ class TopDashboard:
         if shed_rate is not None:
             parts.append(f"shed/s {shed_rate:.1f}")
         lines.append("fleet    " + "  ".join(parts))
+
+        # generative decode lane: fleet totals hold summed
+        # ``generate.<model>.<key>`` stats; match on exact key depth so
+        # the lane's prefix_hits is not conflated with kv.prefix_hits
+        def _gsum(*tail: str) -> float:
+            want = list(tail)
+            return sum(float(v) for k, v in fleet.items()
+                       if isinstance(v, (int, float))
+                       and k.split(".")[:1] == ["generate"]
+                       and k.split(".")[2:] == want)
+
+        if any(k.startswith("generate.") for k in fleet):
+            hits, misses = _gsum("prefix_hits"), _gsum("prefix_misses")
+            prop, acc = _gsum("spec_proposed"), _gsum("spec_accepted")
+            saved = (_gsum("kv", "unquantized_arena_bytes")
+                     - _gsum("kv", "arena_bytes"))
+            parts = [
+                f"prefix {100.0 * hits / max(1.0, hits + misses):.1f}%",
+                f"cow {_gsum('cow_copies'):.0f}",
+                f"spec {100.0 * acc / prop:.1f}%" if prop else "spec -"]
+            if _gsum("kv", "quantized"):
+                parts.append(f"int8 saved {format_bytes(max(0.0, saved))}")
+            lines.append("decode   " + "  ".join(parts))
 
         for st in slo_status or []:
             flag = "BREACH" if st["breaching"] else (

@@ -29,7 +29,11 @@ print where the time went —
   occupancy and decode-step facts from the generate lane's
   ``generate.request`` / ``decode.step`` events, plus shed/expired
   counts, fleet failover-restarts (``fleet.failover`` with
-  ``kind=generate``), and the slowest-TTFT exemplar trace ids;
+  ``kind=generate``), the slowest-TTFT exemplar trace ids, and the
+  decode-speed signatures: prefix-cache hit rate / CoW copies
+  (``decode.prefix`` / ``decode.cow``), speculation acceptance
+  (``generate.request`` spec fields), and int8 KV arena savings
+  (``decode.arena``);
 - fleet: router activity from ``fleet.*`` events (failovers by replica,
   fleet-wide sheds, tenant throttles, replica kills) and rollout progress
   from ``rollout.*`` events (shifted/warmed replicas per model version);
@@ -332,6 +336,31 @@ def build_report(path, top: int = 10,
                 "count": len(steps),
                 "mean_active": round(_mean(steps, "active"), 2),
                 "mean_step_ms": round(_mean(steps, "step_ms"), 3)}
+        pref = [e for e in dec_ev if e.get("name") == "prefix"]
+        cows = [e for e in dec_ev if e.get("name") == "cow"]
+        if pref or cows:
+            hits = sum(int(e.get("hits", 0)) for e in pref)
+            misses = sum(int(e.get("misses", 0)) for e in pref)
+            gv["prefix_cache"] = {
+                "hits": hits, "misses": misses,
+                "hit_rate": round(hits / max(1, hits + misses), 4),
+                "cached_tokens": sum(int(e.get("cached_tokens", 0))
+                                     for e in pref),
+                "cow_copies": len(cows)}
+        proposed = sum(int(e.get("spec_proposed", 0)) for e in greqs)
+        if proposed:
+            accepted = sum(int(e.get("spec_accepted", 0)) for e in greqs)
+            gv["speculation"] = {
+                "proposed": proposed, "accepted": accepted,
+                "accept_rate": round(accepted / proposed, 4)}
+        quant = [e for e in dec_ev if e.get("name") == "arena"
+                 and str(e.get("kv_dtype", "")) == "int8"]
+        if quant:
+            arena = sum(int(e.get("arena_bytes", 0)) for e in quant)
+            gv["int8_kv"] = {
+                "arenas": len(quant), "arena_bytes": arena,
+                "saved_bytes": sum(int(e.get("unquantized_bytes", 0))
+                                   for e in quant) - arena}
         report["generate"] = gv
 
     # -- fleet (router + rollout) ------------------------------------------
@@ -671,6 +700,24 @@ def render_report(path, top: int = 10) -> str:
                 f"  decode steps: {ds['count']} "
                 f"(mean active={ds['mean_active']:.2f}, "
                 f"mean step={ds['mean_step_ms']:.3f}ms)")
+        if "prefix_cache" in gv:
+            pc = gv["prefix_cache"]
+            out.append(
+                f"  prefix cache: {pc['hit_rate'] * 100:.1f}% hit "
+                f"({pc['hits']}/{pc['hits'] + pc['misses']} blocks, "
+                f"{pc['cached_tokens']} prompt tokens reused, "
+                f"{pc['cow_copies']} CoW copies)")
+        if "speculation" in gv:
+            sp = gv["speculation"]
+            out.append(
+                f"  speculation: {sp['accept_rate'] * 100:.1f}% accepted "
+                f"({sp['accepted']}/{sp['proposed']} draft tokens)")
+        if "int8_kv" in gv:
+            q = gv["int8_kv"]
+            out.append(
+                f"  int8 KV: {q['arenas']} arena(s), "
+                f"{q['arena_bytes'] / 1e6:.1f}MB stored, "
+                f"{q['saved_bytes'] / 1e6:.1f}MB saved vs fp")
         out.append("")
 
     if "fleet" in r:

@@ -573,6 +573,22 @@ def run_decode_scenario(seed: int, outdir: str, replicas: int = 2,
     - ``failover_observed``      — the kill really forced >= 1 failover;
     - ``no_unhandled_exceptions``— nothing escaped the router/retry
       channel.
+
+    3. **shared-prefix kill** (phase 3) — two sequences ride the SAME
+       cached system-prompt blocks (refcount > 1) and one is killed
+       mid-stream while holding them. Invariants:
+
+    - ``prefix_sharing_observed``   — the sharers really held common
+      blocks with refcount > 1 when the kill landed;
+    - ``prefix_refcounts_reconcile``— after the survivor finishes, the
+      block ledger is empty (``used_blocks == 0``) and conservation
+      holds (every block in exactly one of free/cached/refcounted);
+    - ``no_leaked_kv_bytes``        — the HBM ledger's ``kind="kv"``
+      charge still equals the arena's real byte footprint (the fixed
+      arena neither grew nor lost accounting through the kill);
+    - ``prefix_restart_bit_identical`` — resubmitting the killed request
+      (the restart) and the surviving sharer both emit token streams
+      bit-identical to a prefix-cache-OFF reference server.
     """
     import threading
 
@@ -691,6 +707,15 @@ def run_decode_scenario(seed: int, outdir: str, replicas: int = 2,
             failovers = int(fleet.router.stats()["failovers"])
         finally:
             fleet.close()
+
+        # phase 3: kill a sequence HOLDING SHARED PREFIX BLOCKS.
+        # Deterministic single server, manually stepped (no threads): two
+        # sharers ride one system prompt's cached KV; one dies mid-decode
+        # with refcount > 1 on the shared blocks; the survivor and the
+        # restarted victim must both stay bit-identical, and the block +
+        # HBM ledgers must reconcile to the token.
+        verdict["prefix"] = _run_shared_prefix_kill(
+            model, rng, seed, errors)
     except Exception as e:
         errors.append(f"decode scenario: {type(e).__name__}: {e}")
     finally:
@@ -720,6 +745,7 @@ def run_decode_scenario(seed: int, outdir: str, replicas: int = 2,
         "failover_observed": failovers >= 1,
         "no_unhandled_exceptions": not errors,
     }
+    invariants.update(verdict.get("prefix", {}).get("invariants", {}))
     verdict["invariants"] = invariants
     verdict["errors"] = errors
     verdict["passed"] = all(invariants.values())
@@ -739,6 +765,112 @@ def run_decode_scenario(seed: int, outdir: str, replicas: int = 2,
         if dumped:
             _LOG.error("chaos: flight recorder dumped to %s", dumped)
     return verdict
+
+
+def _run_shared_prefix_kill(model, rng, seed: int,
+                            errors: List[str]) -> Dict[str, Any]:
+    """Phase 3 of the decode scenario: kill a sequence that is HOLDING
+    shared prefix blocks (refcount > 1) mid-stream.
+
+    Deterministic by construction — one :class:`Server` stepped by hand,
+    no threads, the kill landed at an exact step boundary — so a red
+    verdict here is a real ledger bug, never scheduling noise. See
+    :func:`run_decode_scenario` for the invariants.
+    """
+    from mmlspark_tpu.observability import memory as devmem
+    from mmlspark_tpu.serve.server import Server
+    from mmlspark_tpu.utils import config as mmlconfig
+
+    bt = int(mmlconfig.get("generate.kv_block_tokens"))
+    sysp = [rng.randrange(1, 200) for _ in range(3 * bt)]  # 3 full blocks
+    pa, pb = sysp + [11, 12], sysp + [21, 22]
+    max_new = 10
+
+    def _stepped(srv, lane, prompt, sd):
+        fut = srv.submit_generate("lm", prompt, max_new_tokens=max_new,
+                                  seed=sd)
+        for _ in range(96):
+            if fut.done():
+                break
+            lane.step()
+        return fut.result(1)["tokens"]
+
+    # independent token ground truth: a reference server with the
+    # prefix cache OFF (no sharing anywhere in its decode path)
+    prior = mmlconfig.get("generate.prefix_cache")
+    mmlconfig.set("generate.prefix_cache", False)
+    try:
+        ref_srv = Server({"lm": model}, start=False)
+        try:
+            ref_lane = ref_srv.enable_generate("lm", start=False)
+            ref_a = _stepped(ref_srv, ref_lane, pa, seed + 101)
+            ref_b = _stepped(ref_srv, ref_lane, pb, seed + 102)
+        finally:
+            ref_srv.close()
+    finally:
+        mmlconfig.set("generate.prefix_cache", prior)
+
+    sharing = reconciled = identical = leak_ok = False
+    victim_surfaced = False
+    shared_blocks = 0
+    stats: Dict[str, Any] = {}
+    srv = Server({"lm": model}, start=False)
+    try:
+        lane = srv.enable_generate("lm", start=False)
+        kv = lane.gen.kv
+        ledger = devmem.get_ledger()
+        charged0 = ledger.total(model="lm", kind="kv")
+        # warm the prefix index, then run both sharers together
+        _stepped(srv, lane, sysp + [1], seed + 100)
+        fa = srv.submit_generate("lm", pa, max_new_tokens=max_new,
+                                 seed=seed + 101)
+        fb = srv.submit_generate("lm", pb, max_new_tokens=max_new,
+                                 seed=seed + 102)
+        lane.step()          # both admitted, riding the cached prefix
+        lane.step()          # ... and decoding: the kill lands MID-stream
+        victim = next((s for s in lane.batcher.active if s.future is fa),
+                      None)
+        if victim is None:
+            errors.append("prefix kill: victim never reached the batch")
+        else:
+            shared = [b for b in kv.blocks_for(victim.seq_id)
+                      if kv.block_refcount(b) > 1]
+            shared_blocks = len(shared)
+            sharing = bool(shared)
+            lane._fail_seq(victim, RuntimeError("chaos: killed mid-stream"))
+            lane.batcher.leave(victim)
+        for _ in range(96):  # the survivor decodes on, unperturbed
+            if fb.done():
+                break
+            lane.step()
+        toks_b = fb.result(1)["tokens"]
+        try:
+            fa.result(0)
+        except RuntimeError:
+            victim_surfaced = True   # the kill reported, not swallowed
+        # the restart: resubmit the killed request from its prompt
+        toks_a = _stepped(srv, lane, pa, seed + 101)
+        identical = (toks_a == ref_a) and (toks_b == ref_b)
+        reconciled = kv.used_blocks == 0 and kv.check_conservation()
+        charged1 = ledger.total(model="lm", kind="kv")
+        leak_ok = charged1 == kv.arena_bytes() and charged1 == charged0
+        stats = {k: v for k, v in lane.stats().items()
+                 if k.startswith(("prefix", "cow", "kv."))}
+    except Exception as e:
+        errors.append(f"prefix kill: {type(e).__name__}: {e}")
+    finally:
+        srv.close()
+    return {
+        "shared_blocks_at_kill": shared_blocks,
+        "stats": stats,
+        "invariants": {
+            "prefix_sharing_observed": sharing,
+            "prefix_refcounts_reconcile": reconciled,
+            "no_leaked_kv_bytes": leak_ok,
+            "prefix_restart_bit_identical": identical,
+            "victim_error_surfaced": victim_surfaced,
+        },
+    }
 
 
 # -- host scenario -----------------------------------------------------------

@@ -88,6 +88,16 @@ machinery and its drain path, so it leaks on shutdown and double-restarts
 under chaos. All process lifecycle goes through the supervisor;
 deliberate exceptions mark the line ``# lint: allow-process``.
 
+Rule 13 — quantization arithmetic (``.astype(np.int8)`` /
+``127``-range scale math) in ``serve/`` outside ``serve/kvcache.py``:
+the int8 KV arena keeps ONE quantization scheme (symmetric per-row
+absmax, ``quantize_rows``/``dequantize_rows``) so stored blocks and
+every program that reads them agree bit-for-bit — an open-coded cast or
+scale formula in a program builder silently diverges from the arena's
+(rounding mode, clip range, scale epsilon) and decodes garbage KV.
+Quant math goes through the ``kvcache`` helpers; deliberate exceptions
+mark the line ``# lint: allow-quant``.
+
 Shared core for ``tools/check_reliability.py`` (standalone CLI),
 ``mmlspark-tpu check`` (installed CLI), and the in-pytest gate
 (tests/test_reliability_lint.py) — same single source of truth pattern as
@@ -161,6 +171,11 @@ _ALLOW_PROCESS = "# lint: allow-process"
 # the ONE module allowed to manage OS processes (it IS the supervisor)
 _PROCESS_HOME = "serve/supervisor.py"
 _PROCESS_OS_CALLS = ("kill", "waitpid")
+_ALLOW_QUANT = "# lint: allow-quant"
+# the ONE serve/ module allowed to open-code KV quantization arithmetic
+# (it owns quantize_rows/dequantize_rows — the single scheme every
+# arena reader and writer must share)
+_QUANT_HOME = "serve/kvcache.py"
 
 
 def _is_raw_sync(call: ast.Call) -> bool:
@@ -254,6 +269,35 @@ def _is_process_call(call: ast.Call) -> bool:
             and isinstance(f.value, ast.Name) and f.value.id == "os")
 
 
+def _mentions_int8(node: ast.expr) -> bool:
+    """``np.int8`` / ``jnp.int8`` / bare ``int8`` / the string
+    ``"int8"`` — any spelling of the quantized storage dtype."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "int8"
+    if isinstance(node, ast.Name):
+        return node.id == "int8"
+    return isinstance(node, ast.Constant) and node.value == "int8"
+
+
+def _is_quant_cast(call: ast.Call) -> bool:
+    """``<x>.astype(np.int8)`` (any int8 spelling) — the narrowing cast
+    at the heart of open-coded KV quantization. Widening casts and
+    casts to other dtypes are not quantization and stay out of scope."""
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "astype"
+            and any(_mentions_int8(a) for a in call.args))
+
+
+def _is_quant_scale_math(node: ast.BinOp) -> bool:
+    """Arithmetic against the ``127``/``127.0`` quantization range
+    constant on either side — scale-factor math (``amax / 127.0``,
+    ``q * scale`` spelled with the range). The magic number IS the
+    signal: no other serve-side arithmetic has a reason to touch it."""
+    def _is_range(n: ast.expr) -> bool:
+        return isinstance(n, ast.Constant) and n.value in (127, 127.0)
+    return _is_range(node.left) or _is_range(node.right)
+
+
 def _is_signal_signal(call: ast.Call) -> bool:
     """``signal.signal(...)`` (or any ``<x>.signal(...)`` attribute call on
     a name ending in ``signal``) — the handler-installation form. A bare
@@ -282,6 +326,8 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     bytes_scoped = "serve/" in norm and not norm.endswith(_BYTES_HOME)
     # Rule 12 scope: everywhere, the supervisor exempt (it IS the owner)
     process_home = norm.endswith(_PROCESS_HOME)
+    # Rule 13 scope: serve/ modules only, the quant-scheme home exempt
+    quant_scoped = "serve/" in norm and not norm.endswith(_QUANT_HOME)
 
     def _allowed(lineno: int) -> bool:
         # marker anywhere on the offending line opts that line out
@@ -315,6 +361,10 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     def _process_allowed(lineno: int) -> bool:
         return (0 < lineno <= len(lines)
                 and _ALLOW_PROCESS in lines[lineno - 1])
+
+    def _quant_allowed(lineno: int) -> bool:
+        return (0 < lineno <= len(lines)
+                and _ALLOW_QUANT in lines[lineno - 1])
 
     for node in ast.walk(tree):
         if (isinstance(node, ast.Call)
@@ -407,6 +457,25 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
                 "(workers need ONE owner — the supervisor's restart/"
                 "drain machinery; route through serve.supervisor, or "
                 f"mark the line `{_ALLOW_PROCESS}`)")
+        elif (isinstance(node, ast.Call) and quant_scoped
+                and _is_quant_cast(node)
+                and not _quant_allowed(node.lineno)):
+            problems.append(
+                f"{filename}:{node.lineno}: int8 quantization cast in "
+                f"serve/ outside {_QUANT_HOME} (a private quant scheme "
+                "diverges from the arena's rounding/clip/scale rules; "
+                "route through kvcache.quantize_rows/dequantize_rows, "
+                f"or mark the line `{_ALLOW_QUANT}`)")
+        elif (isinstance(node, ast.BinOp) and quant_scoped
+                and _is_quant_scale_math(node)
+                and not _quant_allowed(node.lineno)):
+            problems.append(
+                f"{filename}:{node.lineno}: quantization scale math "
+                f"(127-range constant) in serve/ outside {_QUANT_HOME} "
+                "(the scheme lives in ONE place so blocks and readers "
+                "agree bit-for-bit; route through kvcache."
+                "quantize_rows/dequantize_rows, or mark the line "
+                f"`{_ALLOW_QUANT}`)")
         elif (isinstance(node, ast.Call) and _is_raw_sync(node)
                 and not sync_home
                 and not _sync_allowed(node.lineno)):

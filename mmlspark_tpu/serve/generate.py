@@ -36,6 +36,33 @@ Decode steps donate the arena buffers (in-place on TPU); the arena's
 attention runs the same fused Pallas flash path as scoring on real chips
 (prefill attention goes through ``full_attention`` inside the module) and
 the jnp reference on the CPU test mesh.
+
+Four compounding raw-speed attacks ride the same seams (all
+config-gated, all compiled through :meth:`GenerativeEntry._compile` so a
+warm restart still pays zero XLA compiles):
+
+- **Shared-prefix KV reuse** (``generate.prefix_cache``): admission
+  hashes the prompt's full blocks (chained — see
+  :func:`~mmlspark_tpu.serve.kvcache.prefix_block_hashes`) and
+  ``KVCacheManager.try_reserve`` shares already-cached blocks, so N
+  requests behind one system prompt pay prefill ONCE; only the uncached
+  suffix runs through the **chunk** program. A full-prompt hit schedules
+  a copy-on-write of the final block (no block is ever written while
+  shared) and recomputes just the last position for its first token.
+- **Chunked prefill** (``generate.prefill_chunk``): long prompts split
+  into fixed-width chunks processed one per lane step, interleaved with
+  decode — a long joiner never stalls the running batch's ITL.
+- **Speculative decoding** (``generate.draft_model`` +
+  ``generate.spec_tokens``): a small draft model (its own
+  :class:`GenerativeEntry` + arena) proposes k tokens per step; the
+  target checks them in ONE **verify** program call (the decode spec
+  widened to k+1 positions). Accept/reject replays the exact
+  per-(seed, position) sampler, so greedy AND seeded-sampling outputs
+  are token-identical to the non-speculative lane by construction.
+- **int8 KV blocks** (``generate.kv_dtype=int8``): the arena stores
+  quantized rows (~2x concurrent-sequence capacity at fixed bytes);
+  dequantization is fused into the decode/verify/chunk programs via the
+  helpers in ``kvcache.py`` (lint Rule 13 keeps scale math there).
 """
 from __future__ import annotations
 
@@ -55,7 +82,8 @@ from mmlspark_tpu.reliability import watchdog as _watchdog
 from mmlspark_tpu.reliability.faults import fault_site
 from mmlspark_tpu.serve.batcher import bucket_for, default_buckets
 from mmlspark_tpu.serve.kvcache import (
-    RESERVED_BLOCK, KVCacheManager, blocks_needed,
+    RESERVED_BLOCK, KVCacheManager, blocks_needed, dequantize_rows,
+    prefix_block_hashes, quantize_rows,
 )
 from mmlspark_tpu.utils import config as mmlconfig
 from mmlspark_tpu.utils.logging import get_logger
@@ -170,7 +198,8 @@ class _Seq:
     __slots__ = ("seq_id", "prompt", "max_new", "temperature", "top_k",
                  "seed", "eos_id", "future", "trace_id", "enqueued",
                  "deadline", "generated", "ttft_s", "last_t", "itl_s",
-                 "finish")
+                 "finish", "prefill_pos", "hashes", "spec_ok",
+                 "spec_proposed", "spec_accepted", "prefix_hits")
 
     def __init__(self, seq_id: str, req: GenerateRequest, future: Future,
                  enqueued: float, deadline: Optional[float]):
@@ -190,6 +219,12 @@ class _Seq:
         self.last_t = enqueued
         self.itl_s: List[float] = []
         self.finish = ""
+        self.prefill_pos = 0            # next prompt position to prefill
+        self.hashes: List[str] = []     # chained full-block prefix hashes
+        self.spec_ok = False            # draft arena reserved: may ride
+        self.spec_proposed = 0          # speculation for this sequence
+        self.spec_accepted = 0
+        self.prefix_hits = 0            # prefix blocks shared at reserve
 
     @property
     def seq_len(self) -> int:
@@ -268,6 +303,12 @@ class ContinuousBatcher:
                 < self.max_sequences:
             out.append(self._waiting.popleft())
         return out
+
+    def requeue(self, seq: _Seq) -> None:
+        """Put a taken-but-not-admitted waiter back at the FRONT of the
+        queue (its slot this step went to a sequence still mid-chunked-
+        prefill); it stays first in line for the next step."""
+        self._waiting.appendleft(seq)
 
     def join(self, seq: _Seq) -> None:
         if len(self._active) >= self.max_sequences:
@@ -348,6 +389,17 @@ class GenerativeEntry:
             str(mmlconfig.get("generate.prefill_buckets")),
             self.max_seq_len, self.block_tokens)
         self.decode_buckets = default_buckets(self.max_sequences)
+        self.prefix_cache = bool(mmlconfig.get("generate.prefix_cache"))
+        self.prefill_chunk = max(0, int(mmlconfig.get(
+            "generate.prefill_chunk")))
+        # the chunk program's width: the configured chunk, else one block
+        # (the chunk path also serves the uncached-SUFFIX prefill after a
+        # prefix hit, so it exists even with chunking nominally off)
+        self.chunk_width = min(self.max_seq_len,
+                               self.prefill_chunk if self.prefill_chunk > 0
+                               else self.block_tokens)
+        self.spec_tokens = max(0, int(mmlconfig.get("generate.spec_tokens")))
+        self.spec_width = self.spec_tokens + 1
         self._programs: Dict[Tuple[str, int], Callable] = {}
         # the arena is HBM this model now pins: charge it to the registry
         # entry so the device-cache LRU sees params + arena as one tenant
@@ -371,12 +423,20 @@ class GenerativeEntry:
             jitted, abstract = self._prefill_spec(bucket)
         elif kind == "decode":
             jitted, abstract = self._decode_spec(bucket)
+        elif kind == "chunk":
+            jitted, abstract = self._chunk_spec(bucket)
+        elif kind == "verify":
+            jitted, abstract = self._verify_spec(bucket)
+        elif kind == "cow":
+            jitted, abstract = self._cow_spec()
         else:
             raise ValueError(f"unknown program kind {kind!r}")
         shape_key = (f"{kind}:{bucket}|arena={self.kv.num_blocks}x"
                      f"{self.block_tokens}x{self.heads}x{self.head_dim}"
                      f"|layers={self.depth}|W={self.table_width}"
                      f"|dtype={self.kv.dtype.name}")
+        if kind == "verify":
+            shape_key += f"|C={self.spec_width}"
         result = compile_cache.load_or_compile_program(
             self.entry.name, self.entry.version, kind, shape_key,
             jitted, self.params, *abstract)
@@ -385,6 +445,17 @@ class GenerativeEntry:
         else:
             self.entry.compile_count += 1
         return result.program
+
+    def _arena_abstract(self):
+        """The arena operand placeholders every program takes right after
+        ``params`` — (k, v) plus the two fp32 scale planes when int8 —
+        and the matching ``donate_argnums``."""
+        import jax
+        arena = jax.ShapeDtypeStruct(self.kv.arena_k.shape, self.kv.dtype)
+        if self.kv.quantized:
+            sc = jax.ShapeDtypeStruct(self.kv.scale_k.shape, np.float32)
+            return (arena, arena, sc, sc), (1, 2, 3, 4)
+        return (arena, arena), (1, 2)
 
     # -- prefill -----------------------------------------------------------
     def _prefill_spec(self, bucket: int):
@@ -398,11 +469,13 @@ class GenerativeEntry:
         module, depth = self.module, self.depth
         nb = bucket // self.block_tokens
         bt, heads, hd = self.block_tokens, self.heads, self.head_dim
+        quant = self.kv.quantized
 
         def kv_filter(mdl, _method):
             return getattr(mdl, "name", None) in ("attn_key", "attn_value")
 
-        def prefill(params, arena_k, arena_v, tokens, last_pos, block_ids):
+        def body(params, arena_k, arena_v, scale_k, scale_v, tokens,
+                 last_pos, block_ids):
             logits, state = module.apply(
                 params, tokens, capture_intermediates=kv_filter,
                 mutable=["intermediates"])
@@ -413,15 +486,29 @@ class GenerativeEntry:
                             [0] for i in range(depth)])
             ks = ks.reshape(depth, nb, bt, heads, hd)
             vs = vs.reshape(depth, nb, bt, heads, hd)
+            if quant:
+                ks, sk = quantize_rows(ks)
+                vs, sv = quantize_rows(vs)
+                scale_k = scale_k.at[:, block_ids].set(sk)
+                scale_v = scale_v.at[:, block_ids].set(sv)
             arena_k = arena_k.at[:, block_ids].set(ks)
             arena_v = arena_v.at[:, block_ids].set(vs)
             row = jnp.take(logits[0], last_pos, axis=0)
-            return arena_k, arena_v, row
+            return arena_k, arena_v, scale_k, scale_v, row
 
-        jitted = jax.jit(prefill, donate_argnums=(1, 2))  # lint: allow-compile
-        arena = jax.ShapeDtypeStruct(self.kv.arena_k.shape, self.kv.dtype)
-        abstract = (
-            arena, arena,
+        if quant:
+            def prefill(params, ak, av, sk, sv, tokens, last_pos, blocks):
+                return body(params, ak, av, sk, sv, tokens, last_pos,
+                            blocks)
+        else:
+            def prefill(params, ak, av, tokens, last_pos, blocks):
+                ak, av, _sk, _sv, row = body(params, ak, av, None, None,
+                                             tokens, last_pos, blocks)
+                return ak, av, row
+
+        arenas, donate = self._arena_abstract()
+        jitted = jax.jit(prefill, donate_argnums=donate)  # lint: allow-compile
+        abstract = arenas + (
             jax.ShapeDtypeStruct((1, bucket), np.int32),
             jax.ShapeDtypeStruct((), np.int32),
             jax.ShapeDtypeStruct((nb,), np.int32),
@@ -442,9 +529,10 @@ class GenerativeEntry:
             self.dim
         bt, W, dtype = self.block_tokens, self.table_width, self.dtype
         scale = 1.0 / np.sqrt(hd)
+        quant = self.kv.quantized
 
-        def decode(params, arena_k, arena_v, tokens, positions,
-                   block_tables, seq_lens):
+        def body(params, arena_k, arena_v, scale_k, scale_v, tokens,
+                 positions, block_tables, seq_lens):
             p = params.get("params", params)
             table = p["token_embedding"]["embedding"]
             x = jnp.take(table.astype(dtype), tokens, axis=0)
@@ -466,15 +554,33 @@ class GenerativeEntry:
                 k = _dense(y, blk["attn_key"], dtype)
                 v = _dense(y, blk["attn_value"], dtype)
                 qh = q.reshape(-1, heads, hd)
+                kr = k.reshape(-1, heads, hd)
+                vr = v.reshape(-1, heads, hd)
                 # scatter FIRST so the current token attends itself
-                arena_k = arena_k.at[i, blk_idx, offs].set(
-                    k.reshape(-1, heads, hd))
-                arena_v = arena_v.at[i, blk_idx, offs].set(
-                    v.reshape(-1, heads, hd))
-                k_all = arena_k[i][block_tables].reshape(
-                    -1, W * bt, heads, hd)
-                v_all = arena_v[i][block_tables].reshape(
-                    -1, W * bt, heads, hd)
+                if quant:
+                    qk, ssk = quantize_rows(kr)
+                    qv, ssv = quantize_rows(vr)
+                    arena_k = arena_k.at[i, blk_idx, offs].set(qk)
+                    arena_v = arena_v.at[i, blk_idx, offs].set(qv)
+                    scale_k = scale_k.at[i, blk_idx, offs].set(ssk)
+                    scale_v = scale_v.at[i, blk_idx, offs].set(ssv)
+                    k_all = dequantize_rows(
+                        arena_k[i][block_tables].reshape(
+                            -1, W * bt, heads, hd),
+                        scale_k[i][block_tables].reshape(
+                            -1, W * bt)).astype(dtype)
+                    v_all = dequantize_rows(
+                        arena_v[i][block_tables].reshape(
+                            -1, W * bt, heads, hd),
+                        scale_v[i][block_tables].reshape(
+                            -1, W * bt)).astype(dtype)
+                else:
+                    arena_k = arena_k.at[i, blk_idx, offs].set(kr)
+                    arena_v = arena_v.at[i, blk_idx, offs].set(vr)
+                    k_all = arena_k[i][block_tables].reshape(
+                        -1, W * bt, heads, hd)
+                    v_all = arena_v[i][block_tables].reshape(
+                        -1, W * bt, heads, hd)
                 s = jnp.einsum("bhd,bkhd->bhk", qh, k_all,
                                preferred_element_type=jnp.float32) * scale
                 s = jnp.where(masked[:, None, :], -jnp.inf, s)
@@ -493,16 +599,278 @@ class GenerativeEntry:
                              p["final_norm"]["bias"])
             logits = jnp.einsum("bd,vd->bv", xf.astype(jnp.float32),
                                 table.astype(jnp.float32))
-            return arena_k, arena_v, logits
+            return arena_k, arena_v, scale_k, scale_v, logits
 
-        jitted = jax.jit(decode, donate_argnums=(1, 2))  # lint: allow-compile
-        arena = jax.ShapeDtypeStruct(self.kv.arena_k.shape, self.kv.dtype)
-        abstract = (
-            arena, arena,
+        if quant:
+            def decode(params, ak, av, sk, sv, tokens, positions, tables,
+                       seq_lens):
+                return body(params, ak, av, sk, sv, tokens, positions,
+                            tables, seq_lens)
+        else:
+            def decode(params, ak, av, tokens, positions, tables,
+                       seq_lens):
+                ak, av, _sk, _sv, out = body(params, ak, av, None, None,
+                                             tokens, positions, tables,
+                                             seq_lens)
+                return ak, av, out
+
+        arenas, donate = self._arena_abstract()
+        jitted = jax.jit(decode, donate_argnums=donate)  # lint: allow-compile
+        abstract = arenas + (
             jax.ShapeDtypeStruct((batch,), np.int32),
             jax.ShapeDtypeStruct((batch,), np.int32),
             jax.ShapeDtypeStruct((batch, W), np.int32),
             jax.ShapeDtypeStruct((batch,), np.int32),
+        )
+        return jitted, abstract
+
+    # -- chunked / suffix prefill -----------------------------------------
+    def _chunk_spec(self, C: int):
+        """Jitted prefill CHUNK: ``C`` consecutive prompt positions of ONE
+        sequence, scatter-first then gather like decode so positions
+        within the chunk attend each other. Serves both chunked prefill
+        (long prompts interleaved with decode) and the uncached-suffix
+        prefill after a prefix-cache hit (``positions`` start at the
+        first uncached token; earlier shared blocks are only READ).
+        Invalid rows (``>= n_valid``) write to reserved scratch and their
+        logits are ignored host-side."""
+        import jax
+        import jax.numpy as jnp
+        depth, heads, hd, dim = self.depth, self.heads, self.head_dim, \
+            self.dim
+        bt, W, dtype = self.block_tokens, self.table_width, self.dtype
+        scale = 1.0 / np.sqrt(hd)
+        quant = self.kv.quantized
+
+        def body(params, arena_k, arena_v, scale_k, scale_v, tokens,
+                 positions, table_row, n_valid):
+            p = params.get("params", params)
+            table = p["token_embedding"]["embedding"]
+            x = jnp.take(table.astype(dtype), tokens, axis=0)      # (C, d)
+            x = x + jnp.take(p["pos_embedding"][0], positions,
+                             axis=0).astype(x.dtype)
+            valid = jnp.arange(C) < n_valid
+            blk_idx = jnp.where(valid, jnp.take(table_row, positions // bt),
+                                RESERVED_BLOCK)
+            offs = positions % bt
+            idx = jnp.arange(W * bt)
+            masked = idx[None, :] > positions[:, None]     # (C, K) causal
+            for i in range(depth):
+                blk = p[f"block{i}"]
+                y = _layer_norm(x, blk["norm1"]["scale"],
+                                blk["norm1"]["bias"])
+                q = _dense(y, blk["attn_query"], dtype)
+                k = _dense(y, blk["attn_key"], dtype)
+                v = _dense(y, blk["attn_value"], dtype)
+                qh = q.reshape(C, heads, hd)
+                kr = k.reshape(C, heads, hd)
+                vr = v.reshape(C, heads, hd)
+                if quant:
+                    qk, ssk = quantize_rows(kr)
+                    qv, ssv = quantize_rows(vr)
+                    arena_k = arena_k.at[i, blk_idx, offs].set(qk)
+                    arena_v = arena_v.at[i, blk_idx, offs].set(qv)
+                    scale_k = scale_k.at[i, blk_idx, offs].set(ssk)
+                    scale_v = scale_v.at[i, blk_idx, offs].set(ssv)
+                    k_all = dequantize_rows(
+                        arena_k[i][table_row].reshape(W * bt, heads, hd),
+                        scale_k[i][table_row].reshape(W * bt)
+                    ).astype(dtype)
+                    v_all = dequantize_rows(
+                        arena_v[i][table_row].reshape(W * bt, heads, hd),
+                        scale_v[i][table_row].reshape(W * bt)
+                    ).astype(dtype)
+                else:
+                    arena_k = arena_k.at[i, blk_idx, offs].set(kr)
+                    arena_v = arena_v.at[i, blk_idx, offs].set(vr)
+                    k_all = arena_k[i][table_row].reshape(
+                        W * bt, heads, hd)
+                    v_all = arena_v[i][table_row].reshape(
+                        W * bt, heads, hd)
+                s = jnp.einsum("chd,khd->chk", qh, k_all,
+                               preferred_element_type=jnp.float32) * scale
+                s = jnp.where(masked[:, None, :], -jnp.inf, s)
+                pr = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("chk,khd->chd", pr.astype(v_all.dtype),
+                               v_all,
+                               preferred_element_type=jnp.float32)
+                o = o.astype(qh.dtype)
+                x = x + _dense(o.reshape(C, dim), blk["attn_out"], dtype)
+                y = _layer_norm(x, blk["norm2"]["scale"],
+                                blk["norm2"]["bias"])
+                h = _dense(y, blk["mlp_up"], dtype)
+                h = jax.nn.gelu(h)
+                x = x + _dense(h, blk["mlp_down"], dtype)
+            xf = _layer_norm(x, p["final_norm"]["scale"],
+                             p["final_norm"]["bias"])
+            logits = jnp.einsum("cd,vd->cv", xf.astype(jnp.float32),
+                                table.astype(jnp.float32))
+            row = jnp.take(logits, jnp.maximum(n_valid - 1, 0), axis=0)
+            return arena_k, arena_v, scale_k, scale_v, row
+
+        if quant:
+            def chunk(params, ak, av, sk, sv, tokens, positions, table_row,
+                      n_valid):
+                return body(params, ak, av, sk, sv, tokens, positions,
+                            table_row, n_valid)
+        else:
+            def chunk(params, ak, av, tokens, positions, table_row,
+                      n_valid):
+                ak, av, _sk, _sv, row = body(params, ak, av, None, None,
+                                             tokens, positions, table_row,
+                                             n_valid)
+                return ak, av, row
+
+        arenas, donate = self._arena_abstract()
+        jitted = jax.jit(chunk, donate_argnums=donate)  # lint: allow-compile
+        abstract = arenas + (
+            jax.ShapeDtypeStruct((C,), np.int32),
+            jax.ShapeDtypeStruct((C,), np.int32),
+            jax.ShapeDtypeStruct((W,), np.int32),
+            jax.ShapeDtypeStruct((), np.int32),
+        )
+        return jitted, abstract
+
+    # -- speculative verify ------------------------------------------------
+    def _verify_spec(self, batch: int):
+        """Jitted speculative VERIFY for one batch bucket: the decode
+        program widened to ``spec_width = spec_tokens + 1`` positions per
+        lane. Row ``j`` of a lane's logits is the target model's
+        next-token distribution after consuming fed token ``j`` — the
+        host accepts draft proposals left to right while they match the
+        target's own sampler, so the emitted stream is token-identical
+        to non-speculative decode by construction. Lanes feed
+        ``n_valid in [1, C]`` tokens (1 = plain decode riding the same
+        program); rows past ``n_valid`` scatter to reserved scratch."""
+        import jax
+        import jax.numpy as jnp
+        depth, heads, hd, dim = self.depth, self.heads, self.head_dim, \
+            self.dim
+        bt, W, dtype = self.block_tokens, self.table_width, self.dtype
+        C = self.spec_width
+        scale = 1.0 / np.sqrt(hd)
+        quant = self.kv.quantized
+
+        def body(params, arena_k, arena_v, scale_k, scale_v, tokens,
+                 positions, block_tables, n_valid):
+            p = params.get("params", params)
+            table = p["token_embedding"]["embedding"]
+            x = jnp.take(table.astype(dtype), tokens, axis=0)   # (B, C, d)
+            x = x + jnp.take(p["pos_embedding"][0], positions,
+                             axis=0).astype(x.dtype)
+            valid = jnp.arange(C)[None, :] < n_valid[:, None]   # (B, C)
+            blk_idx = jnp.take_along_axis(block_tables, positions // bt,
+                                          axis=1)
+            blk_idx = jnp.where(valid, blk_idx, RESERVED_BLOCK)
+            offs = positions % bt
+            idx = jnp.arange(W * bt)
+            masked = idx[None, None, :] > positions[:, :, None]  # (B,C,K)
+            for i in range(depth):
+                blk = p[f"block{i}"]
+                y = _layer_norm(x, blk["norm1"]["scale"],
+                                blk["norm1"]["bias"])
+                q = _dense(y, blk["attn_query"], dtype)
+                k = _dense(y, blk["attn_key"], dtype)
+                v = _dense(y, blk["attn_value"], dtype)
+                qh = q.reshape(-1, C, heads, hd)
+                kr = k.reshape(-1, C, heads, hd)
+                vr = v.reshape(-1, C, heads, hd)
+                # scatter the whole window FIRST: row j attends rows < j
+                # of its own window through the arena, like decode
+                if quant:
+                    qk, ssk = quantize_rows(kr)
+                    qv, ssv = quantize_rows(vr)
+                    arena_k = arena_k.at[i, blk_idx, offs].set(qk)
+                    arena_v = arena_v.at[i, blk_idx, offs].set(qv)
+                    scale_k = scale_k.at[i, blk_idx, offs].set(ssk)
+                    scale_v = scale_v.at[i, blk_idx, offs].set(ssv)
+                    k_all = dequantize_rows(
+                        arena_k[i][block_tables].reshape(
+                            -1, W * bt, heads, hd),
+                        scale_k[i][block_tables].reshape(
+                            -1, W * bt)).astype(dtype)
+                    v_all = dequantize_rows(
+                        arena_v[i][block_tables].reshape(
+                            -1, W * bt, heads, hd),
+                        scale_v[i][block_tables].reshape(
+                            -1, W * bt)).astype(dtype)
+                else:
+                    arena_k = arena_k.at[i, blk_idx, offs].set(kr)
+                    arena_v = arena_v.at[i, blk_idx, offs].set(vr)
+                    k_all = arena_k[i][block_tables].reshape(
+                        -1, W * bt, heads, hd)
+                    v_all = arena_v[i][block_tables].reshape(
+                        -1, W * bt, heads, hd)
+                s = jnp.einsum("bchd,bkhd->bchk", qh, k_all,
+                               preferred_element_type=jnp.float32) * scale
+                s = jnp.where(masked[:, :, None, :], -jnp.inf, s)
+                pr = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("bchk,bkhd->bchd", pr.astype(v_all.dtype),
+                               v_all,
+                               preferred_element_type=jnp.float32)
+                o = o.astype(qh.dtype)
+                x = x + _dense(o.reshape(-1, C, dim), blk["attn_out"],
+                               dtype)
+                y = _layer_norm(x, blk["norm2"]["scale"],
+                                blk["norm2"]["bias"])
+                h = _dense(y, blk["mlp_up"], dtype)
+                h = jax.nn.gelu(h)
+                x = x + _dense(h, blk["mlp_down"], dtype)
+            xf = _layer_norm(x, p["final_norm"]["scale"],
+                             p["final_norm"]["bias"])
+            logits = jnp.einsum("bcd,vd->bcv", xf.astype(jnp.float32),
+                                table.astype(jnp.float32))
+            return arena_k, arena_v, scale_k, scale_v, logits
+
+        if quant:
+            def verify(params, ak, av, sk, sv, tokens, positions, tables,
+                       n_valid):
+                return body(params, ak, av, sk, sv, tokens, positions,
+                            tables, n_valid)
+        else:
+            def verify(params, ak, av, tokens, positions, tables,
+                       n_valid):
+                ak, av, _sk, _sv, out = body(params, ak, av, None, None,
+                                             tokens, positions, tables,
+                                             n_valid)
+                return ak, av, out
+
+        arenas, donate = self._arena_abstract()
+        jitted = jax.jit(verify, donate_argnums=donate)  # lint: allow-compile
+        abstract = arenas + (
+            jax.ShapeDtypeStruct((batch, C), np.int32),
+            jax.ShapeDtypeStruct((batch, C), np.int32),
+            jax.ShapeDtypeStruct((batch, W), np.int32),
+            jax.ShapeDtypeStruct((batch,), np.int32),
+        )
+        return jitted, abstract
+
+    # -- copy-on-write block copy -----------------------------------------
+    def _cow_spec(self):
+        """Device block copy ``src -> dst`` across every layer (and the
+        scale planes when int8) — the copy-on-write a full-prefix-hit
+        joiner owes before it may write its final prompt block."""
+        import jax
+        quant = self.kv.quantized
+
+        if quant:
+            def cow(params, ak, av, sk, sv, src, dst):
+                ak = ak.at[:, dst].set(ak[:, src])
+                av = av.at[:, dst].set(av[:, src])
+                sk = sk.at[:, dst].set(sk[:, src])
+                sv = sv.at[:, dst].set(sv[:, src])
+                return ak, av, sk, sv
+        else:
+            def cow(params, ak, av, src, dst):
+                ak = ak.at[:, dst].set(ak[:, src])
+                av = av.at[:, dst].set(av[:, src])
+                return ak, av
+
+        arenas, donate = self._arena_abstract()
+        jitted = jax.jit(cow, donate_argnums=donate)  # lint: allow-compile
+        abstract = arenas + (
+            jax.ShapeDtypeStruct((), np.int32),
+            jax.ShapeDtypeStruct((), np.int32),
         )
         return jitted, abstract
 
@@ -533,8 +901,24 @@ class GenerateLane:
         entry = server.registry.get(model)
         self.gen = GenerativeEntry(entry)
         server.registry.touch(entry)
+        # speculative decoding: the draft model gets its OWN entry (its
+        # own arena + programs) sized to the same sequence envelope, so
+        # target and draft block ledgers never interact
+        self.draft: Optional[GenerativeEntry] = None
+        draft_name = str(mmlconfig.get("generate.draft_model")).strip()
+        if draft_name and self.gen.spec_tokens > 0:
+            dentry = server.registry.get(draft_name)
+            self.draft = GenerativeEntry(
+                dentry, max_seq_len=self.gen.max_seq_len,
+                max_sequences=self.gen.max_sequences)
+            if self.draft.vocab != self.gen.vocab:
+                raise ValueError(
+                    f"draft model {draft_name!r} vocab {self.draft.vocab} "
+                    f"!= target {model!r} vocab {self.gen.vocab}")
+            server.registry.touch(dentry)
         self.batcher = ContinuousBatcher(self.gen.max_sequences,
                                          clock=self.clock)
+        self._prefilling: List[_Seq] = []   # joined the arena, mid-chunk
         # deliberately unbounded: backpressure is the KV arena — submit()
         # reserved every enqueued sequence's full block budget, so the
         # queue can never hold more than the arena admits
@@ -548,7 +932,20 @@ class GenerateLane:
         self._expired = server._twin("generate.expired")
         self._completed = server._twin("generate.completed")
         self._failed = server._twin("generate.failed")
+        self._prefix_hits = server._twin("generate.prefix_hits")
+        self._prefix_misses = server._twin("generate.prefix_misses")
+        self._cow_copies = server._twin("generate.cow_copies")
+        self._spec_proposed = server._twin("generate.spec_proposed")
+        self._spec_accepted = server._twin("generate.spec_accepted")
         self.steps = 0          # decode steps taken (chaos kill trigger)
+        if events.recording_enabled():
+            kv = self.gen.kv
+            events.emit("decode", "arena", model=self.model,
+                        blocks=kv.num_blocks,
+                        block_tokens=kv.block_tokens,
+                        kv_dtype=str(kv.dtype),
+                        arena_bytes=kv.arena_bytes(),
+                        unquantized_bytes=kv.unquantized_arena_bytes())
         if start:
             self.start()
 
@@ -580,14 +977,18 @@ class GenerateLane:
             self._thread = None
         leftovers = [s for s in self._drain_queue() if s is not _STOP]
         leftovers.extend(self.batcher.drain())
+        leftovers.extend(self._prefilling)
+        self._prefilling.clear()
         for seq in leftovers:
-            self.gen.kv.free(seq.seq_id)
+            self._release_blocks(seq)
             if not seq.future.done():
                 self._failed.inc()
                 seq.future.set_exception(ServerClosed(
                     "server closed mid-generation; restart from prompt "
                     "elsewhere"))
         self.gen.release()
+        if self.draft is not None:
+            self.draft.release()
 
     @property
     def closed(self) -> bool:
@@ -629,9 +1030,16 @@ class GenerateLane:
         # sequence can fail for memory
         bucket = bucket_for(prompt.size, self.gen.prefill_buckets)
         span_tokens = max(bucket, prompt.size + max_new)
+        hashes: List[str] = []
+        if self.gen.prefix_cache:
+            hashes = prefix_block_hashes(
+                self.model, self.gen.kv.dtype.name, prompt,
+                self.gen.block_tokens)
         fault_site("generate.enqueue", {"model": self.model,
                                         "prompt": int(prompt.size)})
-        blocks = self.gen.kv.try_reserve(seq_id, span_tokens)
+        blocks = self.gen.kv.try_reserve(
+            seq_id, span_tokens, prefix_hashes=hashes,
+            prompt_tokens=int(prompt.size))
         if blocks is None:
             self._shed.inc()
             if events.recording_enabled():
@@ -646,6 +1054,24 @@ class GenerateLane:
                 retry_after=float(mmlconfig.get("serving.retry_after_s")))
         seq = _Seq(seq_id, req, Future(), now, deadline)
         seq.future.trace_id = req.trace_id
+        seq.hashes = hashes
+        info = self.gen.kv.reserve_info(seq_id)
+        seq.prefix_hits = int(info["hits"])
+        if info["hits"]:
+            self._prefix_hits.inc(info["hits"])
+        if info["misses"]:
+            self._prefix_misses.inc(info["misses"])
+        if self.draft is not None:
+            # best-effort: a full draft arena only disables speculation
+            # for this sequence, it never sheds the request
+            seq.spec_ok = self.draft.kv.try_reserve(
+                seq_id, span_tokens) is not None
+        if hashes and events.recording_enabled():
+            events.emit("decode", "prefix", model=self.model,
+                        hits=int(info["hits"]), misses=int(info["misses"]),
+                        cached_tokens=int(info["cached_tokens"]),
+                        cow=bool(info["pending_cow"]),
+                        trace_id=req.trace_id)
         self._queue.put(seq)
         self._admitted.inc()
         return seq.future
@@ -662,7 +1088,7 @@ class GenerateLane:
         stopping = False
         while True:
             hb.beat()
-            busy = self.batcher.ready()
+            busy = self.batcher.ready() or bool(self._prefilling)
             try:
                 item = self._queue.get(timeout=0.0 if busy else 0.05)
             except queue.Empty:
@@ -678,7 +1104,7 @@ class GenerateLane:
                     self.batcher.offer(s)
             if stopping:
                 return              # close() resolves whatever is left
-            if self.batcher.ready():
+            if self.batcher.ready() or self._prefilling:
                 self.step()
 
     def _drain_queue(self) -> List:
@@ -691,16 +1117,29 @@ class GenerateLane:
 
     # -- one continuous-batching step (public: tests drive it) ------------
     def step(self) -> None:
-        """Admit joiners (prefill + first token), then run ONE decode step
-        over the active set. Sequences finishing this step leave and free
-        their blocks before the next step's joiners are considered."""
+        """Advance mid-prefill sequences one chunk, admit joiners
+        (prefill + first token), then run ONE decode step over the active
+        set — chunked prefill interleaves with decode at exactly this
+        boundary, so a long joiner costs the running batch one chunk of
+        latency per step instead of its whole prompt. Sequences finishing
+        this step leave and free their blocks before the next step's
+        joiners are considered."""
         for s in self._drain_queue():
             if s is not _STOP:
                 self.batcher.offer(s)
-        for seq in self.batcher.take():
+        for seq in list(self._prefilling):
+            self._prefill_chunk_step(seq)
+        taken = self.batcher.take()
+        room = max(0, self.batcher.free_slots - len(self._prefilling))
+        for seq in reversed(taken[room:]):
+            self.batcher.requeue(seq)   # slots held by mid-chunk prefills
+        for seq in taken[:room]:
             self._admit_one(seq)
         if self.batcher.active:
-            self._decode_step()
+            if self.draft is not None:
+                self._decode_step_spec()
+            else:
+                self._decode_step()
         if metrics.metrics_enabled():
             metrics.gauge("generate.kv_occupancy").set(
                 self.gen.kv.occupancy())
@@ -709,7 +1148,7 @@ class GenerateLane:
         now = self.clock()
         if seq.expired(now):
             from mmlspark_tpu.serve.server import RequestExpired
-            self.gen.kv.free(seq.seq_id)
+            self._release_blocks(seq)
             self._expired.inc()
             if events.recording_enabled():
                 events.emit("generate", "expired", model=self.model,
@@ -718,18 +1157,47 @@ class GenerateLane:
             seq.future.set_exception(RequestExpired(
                 "deadline passed before prefill"))
             return
-        try:
-            self._prefill(seq)
-        except Exception as e:
-            logger.error("prefill failed for %s: %s", seq.seq_id, e)
-            self.gen.kv.free(seq.seq_id)
-            self._failed.inc()
-            if not seq.future.done():
-                seq.future.set_exception(e)
+        gen = self.gen
+        Lp = int(seq.prompt.size)
+        info = gen.kv.reserve_info(seq.seq_id)
+        cached = min(int(info["cached_tokens"]), Lp)
+        cow = gen.kv.take_pending_cow(seq.seq_id)
+        if cow is not None:
+            # full-prefix hit: copy the final shared block into this
+            # sequence's owned block BEFORE its first (re)write
+            try:
+                self._cow_copy(gen, cow)
+            except Exception as e:
+                logger.error("cow copy failed for %s: %s", seq.seq_id, e)
+                self._fail_seq(seq, e)
+                return
+            gen.kv.cow_done(seq.seq_id)
+            self._cow_copies.inc()
+            if events.recording_enabled():
+                events.emit("decode", "cow", model=self.model,
+                            src=cow[0], dst=cow[1], trace_id=seq.trace_id)
+        # the legacy whole-prompt prefill scatters EVERY leading block,
+        # so any reservation that shares cached blocks must take the
+        # chunk path (it only writes from the first uncached position)
+        use_chunk = cached > 0 or (gen.prefill_chunk > 0
+                                   and Lp > gen.chunk_width)
+        if not use_chunk:
+            try:
+                self._prefill(seq)
+            except Exception as e:
+                logger.error("prefill failed for %s: %s", seq.seq_id, e)
+                self._fail_seq(seq, e)
+                return
+            self.batcher.join(seq)
+            if seq.finish:          # eos / budget hit on the first token
+                self._finish(seq)
             return
-        self.batcher.join(seq)
-        if seq.finish:              # eos / budget hit on the first token
-            self._finish(seq)
+        # chunk path: compute only the uncached suffix, one chunk per
+        # lane step; a FULL hit recomputes just the last prompt position
+        # (into the CoW'd block) to sample its first token
+        seq.prefill_pos = cached if cached < Lp else max(Lp - 1, 0)
+        self._prefilling.append(seq)
+        self._prefill_chunk_step(seq)
 
     def _prefill(self, seq: _Seq) -> None:
         gen = self.gen
@@ -745,12 +1213,13 @@ class GenerateLane:
         t0 = self.clock()
         with spans.span("decode", "prefill", model=self.model,
                         bucket=bucket):
-            ak, av, row = program(gen.params, gen.kv.arena_k,
-                                  gen.kv.arena_v, tokens,
-                                  np.int32(Lp - 1), block_ids)
-            gen.kv.swap(ak, av)
+            row = self._call(gen, program, tokens, np.int32(Lp - 1),
+                             block_ids)
             logits = np.asarray(
                 syncs.device_get(row, "generate.prefill"), np.float32)
+        if seq.hashes:
+            gen.kv.register_prefix(seq.seq_id, seq.hashes)
+        self._draft_prefill(seq)
         now = self.clock()
         self._append_token(seq, logits, position=Lp)
         seq.ttft_s = now - seq.enqueued
@@ -763,6 +1232,246 @@ class GenerateLane:
                         bucket=bucket, prompt=Lp,
                         prefill_ms=round((now - t0) * 1e3, 3),
                         trace_id=seq.trace_id)
+
+    # -- shared program-call plumbing --------------------------------------
+    @staticmethod
+    def _call(entry: GenerativeEntry, program, *operands):
+        """Run one arena program against ``entry``'s KV manager: pass the
+        current (donated) arena set, store the returned set back, and
+        hand the caller whatever payload follows it (logits/row), if
+        any. Works for the target and the draft entry alike."""
+        kv = entry.kv
+        if kv.quantized:
+            out = program(entry.params, kv.arena_k, kv.arena_v,
+                          kv.scale_k, kv.scale_v, *operands)
+            kv.swap(*out[:4])
+            tail = out[4:]
+        else:
+            out = program(entry.params, kv.arena_k, kv.arena_v, *operands)
+            kv.swap(*out[:2])
+            tail = out[2:]
+        return tail[0] if tail else None
+
+    def _cow_copy(self, entry: GenerativeEntry,
+                  pair: Tuple[int, int]) -> None:
+        program = entry.program_for("cow", 0)
+        self._call(entry, program, np.int32(pair[0]), np.int32(pair[1]))
+
+    def _release_blocks(self, seq: _Seq) -> None:
+        """Free every block lease the sequence holds — target arena and,
+        when speculation reserved one, the draft arena (both idempotent)."""
+        self.gen.kv.free(seq.seq_id)
+        if self.draft is not None:
+            self.draft.kv.free(seq.seq_id)
+
+    def _fail_seq(self, seq: _Seq, exc: Exception) -> None:
+        self._release_blocks(seq)
+        self._failed.inc()
+        if not seq.future.done():
+            seq.future.set_exception(exc)
+
+    # -- chunked / suffix prefill ------------------------------------------
+    def _prefill_chunk_step(self, seq: _Seq) -> None:
+        """One chunk of ``seq``'s remaining prompt through the chunk
+        program. On the final chunk the sequence samples its first token
+        (TTFT), registers its prefix blocks, and joins the active set."""
+        gen = self.gen
+        Lp = int(seq.prompt.size)
+        C = gen.chunk_width
+        start = seq.prefill_pos
+        n_valid = min(C, Lp - start)
+        final = start + n_valid >= Lp
+        tokens = np.zeros((C,), np.int32)
+        tokens[:n_valid] = seq.prompt[start:start + n_valid]
+        positions = (start + np.arange(C)).astype(np.int32)
+        table_row = gen.kv.block_table(seq.seq_id, gen.table_width)
+        program = gen.program_for("chunk", C)
+        fault_site("generate.prefill", {"model": self.model, "bucket": C,
+                                        "start": start})
+        t0 = self.clock()
+        try:
+            with spans.span("decode", "prefill_chunk", model=self.model,
+                            chunk=C, start=start):
+                row = self._call(gen, program, tokens, positions,
+                                 table_row, np.int32(n_valid))
+                if final:
+                    logits = np.asarray(
+                        syncs.device_get(row, "generate.prefill"),
+                        np.float32)
+        except Exception as e:
+            logger.error("chunk prefill failed for %s: %s", seq.seq_id, e)
+            if seq in self._prefilling:
+                self._prefilling.remove(seq)
+            self._fail_seq(seq, e)
+            return
+        seq.prefill_pos = start + n_valid
+        if not final:
+            return
+        self._prefilling.remove(seq)
+        if seq.hashes:
+            gen.kv.register_prefix(seq.seq_id, seq.hashes)
+        self._draft_prefill(seq)
+        now = self.clock()
+        self._append_token(seq, logits, position=Lp)
+        seq.ttft_s = now - seq.enqueued
+        seq.last_t = now
+        if metrics.metrics_enabled():
+            metrics.histogram("generate.ttft_ms").observe(
+                seq.ttft_s * 1e3, exemplar=seq.trace_id)
+        if events.recording_enabled():
+            events.emit("decode", "prefill", model=self.model,
+                        bucket=C, prompt=Lp, chunked=True,
+                        cached_tokens=seq.prefix_hits * gen.block_tokens,
+                        prefill_ms=round((now - t0) * 1e3, 3),
+                        trace_id=seq.trace_id)
+        self.batcher.join(seq)
+        if seq.finish:              # eos / budget hit on the first token
+            self._finish(seq)
+
+    # -- speculative decoding ----------------------------------------------
+    def _draft_prefill(self, seq: _Seq) -> None:
+        """Materialize the draft model's KV for the prompt. Failure only
+        degrades the sequence to non-speculative decode."""
+        if self.draft is None or not seq.spec_ok:
+            return
+        d = self.draft
+        try:
+            Lp = int(seq.prompt.size)
+            bucket = bucket_for(Lp, d.prefill_buckets)
+            nb = bucket // d.block_tokens
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :Lp] = seq.prompt
+            block_ids = np.asarray(d.kv.blocks_for(seq.seq_id)[:nb],
+                                   np.int32)
+            program = d.program_for("prefill", bucket)
+            self._call(d, program, tokens, np.int32(Lp - 1), block_ids)
+        except Exception as e:
+            logger.warning("draft prefill failed for %s (speculation off "
+                           "for this sequence): %s", seq.seq_id, e)
+            d.kv.free(seq.seq_id)
+            seq.spec_ok = False
+
+    def _draft_propose(self, active: List[_Seq], fed: np.ndarray,
+                       drafts: np.ndarray) -> None:
+        """Run the draft model ``max(fed) - 1`` single-token decode steps
+        over the spec-riding lanes, sampling each proposal with the SAME
+        per-(seed, position) sampler the target uses — so a correct draft
+        matches the target's token exactly, in greedy AND seeded-sampling
+        modes. Lanes whose window is exhausted mask out (reserved-block
+        writes), like empty decode lanes."""
+        d = self.draft
+        B = bucket_for(len(active), d.decode_buckets)
+        W = d.table_width
+        prev = np.array([seq.generated[-1] for seq in active], np.int64)
+        for j in range(drafts.shape[1]):
+            tokens = np.zeros((B,), np.int32)
+            positions = np.zeros((B,), np.int32)
+            tables = np.full((B, W), RESERVED_BLOCK, np.int32)
+            seq_lens = np.zeros((B,), np.int32)
+            lanes = [i for i, seq in enumerate(active)
+                     if j < int(fed[i]) - 1]
+            if not lanes:
+                return
+            for i in lanes:
+                seq = active[i]
+                tokens[i] = prev[i]
+                positions[i] = seq.seq_len - 1 + j
+                tables[i] = d.kv.block_table(seq.seq_id, W)
+                seq_lens[i] = seq.seq_len + j
+            program = d.program_for("decode", B)
+            logits = self._call(d, program, tokens, positions, tables,
+                                seq_lens)
+            rows = np.asarray(
+                syncs.device_get(logits, "generate.draft"), np.float32)
+            for i in lanes:
+                seq = active[i]
+                tok = sample_token(rows[i], temperature=seq.temperature,
+                                   top_k=seq.top_k, seed=seq.seed,
+                                   position=seq.seq_len + j)
+                drafts[i, j] = tok
+                prev[i] = tok
+
+    def _decode_step_spec(self) -> None:
+        """One speculative step: the draft proposes up to ``spec_tokens``
+        tokens per lane, the target checks the whole window in ONE verify
+        call, and each lane accepts proposals left to right while they
+        match what the target's own sampler would have emitted — so the
+        output stream is token-identical to plain decode, at up to
+        ``spec_width`` tokens per target step. Lanes that cannot
+        speculate (draft arena full, window exhausted) ride the same
+        program with a one-token window."""
+        gen = self.gen
+        active = self.batcher.active
+        B = bucket_for(len(active), gen.decode_buckets)
+        C = gen.spec_width
+        W = gen.table_width
+        fed = np.ones((len(active),), np.int64)
+        for i, seq in enumerate(active):
+            remaining = seq.max_new - len(seq.generated)
+            if seq.spec_ok and remaining > 1:
+                fed[i] = min(C, remaining)
+        gamma = int(fed.max()) - 1
+        drafts = np.zeros((len(active), max(gamma, 0)), np.int64)
+        if gamma > 0:
+            self._draft_propose(active, fed, drafts)
+        tokens = np.zeros((B, C), np.int32)
+        positions = np.zeros((B, C), np.int32)
+        tables = np.full((B, W), RESERVED_BLOCK, np.int32)
+        n_valid = np.zeros((B,), np.int32)
+        for i, seq in enumerate(active):
+            f = int(fed[i])
+            tokens[i, 0] = seq.generated[-1]
+            tokens[i, 1:f] = drafts[i, :f - 1]
+            positions[i] = seq.seq_len - 1 + np.arange(C)
+            tables[i] = gen.kv.block_table(seq.seq_id, W)
+            n_valid[i] = f
+        program = gen.program_for("verify", B)
+        fault_site("generate.step", {"model": self.model, "batch": B,
+                                     "active": len(active)})
+        t0 = self.clock()
+        with spans.span("decode", "step", model=self.model, batch=B,
+                        active=len(active), spec=True):
+            logits = self._call(gen, program, tokens, positions, tables,
+                                n_valid)
+            rows = np.asarray(
+                syncs.device_get(logits, "generate.step"), np.float32)
+        now = self.clock()
+        self.steps += 1
+        hot = metrics.metrics_enabled()
+        emitted = 0
+        for i, seq in enumerate(active):
+            f = int(fed[i])
+            appended = 0
+            matched = 0
+            for j in range(f):
+                self._append_token(seq, rows[i, j], position=seq.seq_len)
+                appended += 1
+                if seq.finish:
+                    break
+                if j < f - 1:
+                    if seq.generated[-1] != int(drafts[i, j]):
+                        break       # divergence: the window past j is junk
+                    matched += 1
+            if f > 1:
+                seq.spec_proposed += f - 1
+                seq.spec_accepted += matched
+                self._spec_proposed.inc(f - 1)
+                self._spec_accepted.inc(matched)
+            emitted += appended
+            gap = (now - seq.last_t) / appended
+            seq.last_t = now
+            seq.itl_s.extend([gap] * appended)
+            if hot:
+                metrics.histogram("generate.itl_ms").observe(
+                    gap * 1e3, exemplar=seq.trace_id)
+            if not seq.finish and seq.expired(now):
+                seq.finish = "deadline"
+            if seq.finish:
+                self._finish(seq)
+        if events.recording_enabled():
+            events.emit("decode", "step", model=self.model, batch=B,
+                        active=len(active), tokens=emitted, spec=True,
+                        step_ms=round((now - t0) * 1e3, 3))
 
     def _decode_step(self) -> None:
         gen = self.gen
@@ -784,10 +1493,8 @@ class GenerateLane:
         t0 = self.clock()
         with spans.span("decode", "step", model=self.model, batch=bucket,
                         active=len(active)):
-            ak, av, logits = program(gen.params, gen.kv.arena_k,
-                                     gen.kv.arena_v, tokens, positions,
-                                     tables, seq_lens)
-            gen.kv.swap(ak, av)
+            logits = self._call(gen, program, tokens, positions, tables,
+                                seq_lens)
             rows = np.asarray(
                 syncs.device_get(logits, "generate.step"), np.float32)
         now = self.clock()
@@ -824,6 +1531,8 @@ class GenerateLane:
     def _finish(self, seq: _Seq) -> None:
         self.batcher.leave(seq)
         freed = self.gen.kv.free(seq.seq_id)
+        if self.draft is not None:
+            self.draft.kv.free(seq.seq_id)
         self._completed.inc()
         now = self.clock()
         if events.recording_enabled():
@@ -838,6 +1547,9 @@ class GenerateLane:
                         else 0.0,
                         total_ms=round((now - seq.enqueued) * 1e3, 3),
                         kv_occupancy=round(self.gen.kv.occupancy(), 4),
+                        prefix_hits=seq.prefix_hits,
+                        spec_proposed=seq.spec_proposed,
+                        spec_accepted=seq.spec_accepted,
                         trace_id=seq.trace_id)
             events.emit("decode", "evict", model=self.model,
                         blocks=freed, trace_id=seq.trace_id)
@@ -852,6 +1564,15 @@ class GenerateLane:
              "failed": self._failed.value,
              "waiting": len(self.batcher),
              "active": len(self.batcher.active),
+             "prefilling": len(self._prefilling),
+             "prefix_hits": self._prefix_hits.value,
+             "prefix_misses": self._prefix_misses.value,
+             "cow_copies": self._cow_copies.value,
+             "spec_proposed": self._spec_proposed.value,
+             "spec_accepted": self._spec_accepted.value,
              "steps": self.steps}
         s.update({f"kv.{k}": v for k, v in self.gen.kv.stats().items()})
+        if self.draft is not None:
+            s["draft.kv.used_blocks"] = self.draft.kv.used_blocks
+            s["draft.kv.free_blocks"] = self.draft.kv.free_blocks
         return s

@@ -6,9 +6,9 @@ short sequences and fragments it as sequences of different lengths join
 and leave the in-flight batch. This module is the vLLM-style answer
 (PAPERS.md: PagedAttention) sized for this framework: ONE fixed arena of
 ``num_blocks`` fixed-size blocks per layer, allocated once at lane
-warm-up, with a host-side free list handing ``ceil(len / block_tokens)``
-blocks to each admitted sequence and reclaiming them the step the
-sequence finishes.
+warm-up, with a host-side block ledger handing ``ceil(len /
+block_tokens)`` blocks to each admitted sequence and reclaiming them the
+step the sequence finishes.
 
 Contracts the rest of the lane builds on:
 
@@ -20,15 +20,33 @@ Contracts the rest of the lane builds on:
   bucket; lanes without a live sequence route their (masked, garbage)
   writes to block 0 so the compiled program never branches on occupancy.
   Real sequences are handed blocks ``1..num_blocks-1`` only.
+- **Shared-prefix reuse (refcounted blocks).** Full prompt blocks are
+  content-addressed: the lane registers each under a CHAINED hash
+  (``sha256(prev_hash | token block)``, so identical tokens after
+  different prefixes never collide) and a later reservation carrying the
+  same hash chain shares the block instead of re-prefilling it. Blocks
+  therefore carry a refcount; a block is only writable by a sequence
+  when its refcount is 1 (copy-on-write otherwise — see
+  :meth:`KVCacheManager.prepare_write`), and a freed block that still
+  holds indexed prefix content parks in an LRU cached pool rather than
+  the free list, reclaimed (refcount 0 only) when admission needs room.
 - **Donation round-trip.** The decode/prefill executables donate the
   arena buffers (in-place update on TPU); callers pass
-  ``arena_k``/``arena_v`` in and MUST store the returned pair back via
-  :meth:`swap` before the next step.
-- **Budget accounting.** ``arena_bytes()`` is charged to the owning
-  :class:`~mmlspark_tpu.serve.registry.ModelEntry` so the registry's
-  ``runtime.device_cache_mb`` LRU sees scoring params and decode arena
-  as one HBM tenant set (``generate.arena_mb`` sizes the arena itself;
-  0 derives it from ``generate.max_sequences`` x ``generate.max_seq_len``).
+  ``arena_k``/``arena_v`` (and the quantization scales, when int8) in
+  and MUST store the returned set back via :meth:`swap` before the next
+  step.
+- **int8 storage (optional).** ``generate.kv_dtype=int8`` stores the
+  arena quantized with one fp32 scale per (layer, block, row): roughly
+  2x the concurrent-sequence capacity at the same byte budget.
+  :func:`quantize_rows` / :func:`dequantize_rows` are the ONLY
+  quantization arithmetic in ``serve/`` (lint Rule 13) — program
+  builders call them, they never open-code scale math.
+- **Budget accounting.** ``arena_bytes()`` (arena + scales, real width)
+  is charged to the owning :class:`~mmlspark_tpu.serve.registry.ModelEntry`
+  so the registry's ``runtime.device_cache_mb`` LRU sees scoring params
+  and decode arena as one HBM tenant set (``generate.arena_mb`` sizes
+  the arena itself; 0 derives it from ``generate.max_sequences`` x
+  ``generate.max_seq_len``).
 
 This module is the ONE sanctioned device-allocation site in ``serve/``
 (lint Rule 10): everything else goes through the registry or marks an
@@ -36,9 +54,11 @@ explicit ``# lint: allow-alloc``.
 """
 from __future__ import annotations
 
+import hashlib
 import math
 import threading
-from typing import Dict, List, Optional, Tuple
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -57,17 +77,71 @@ def blocks_needed(tokens: int, block_tokens: int) -> int:
     return max(1, math.ceil(int(tokens) / int(block_tokens)))
 
 
+def prefix_block_hashes(model: str, kv_dtype: str, prompt: Sequence[int],
+                        block_tokens: int) -> List[str]:
+    """Chained content hashes for every FULL block of ``prompt``.
+
+    ``h[i] = sha256(h[i-1] | tokens of block i)`` — the chain makes a
+    block's identity a function of the ENTIRE prefix through it, which is
+    what its cached K/V actually depends on. The partial trailing block
+    (if any) is never hashed: its K/V would be extended in place by
+    decode, so it is never shareable.
+    """
+    toks = np.asarray(prompt, np.int32).ravel()
+    out: List[str] = []
+    prev = f"{model}|{kv_dtype}|bt={int(block_tokens)}".encode()
+    for i in range(int(toks.size) // int(block_tokens)):
+        h = hashlib.sha256()
+        h.update(prev)
+        h.update(toks[i * block_tokens:(i + 1) * block_tokens].tobytes())
+        prev = h.digest()
+        out.append(h.hexdigest())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# int8 block quantization — the ONE quant-arithmetic site in serve/
+# (lint Rule 13). Traced inside the compiled prefill/decode/verify
+# programs; per-row scales keep incremental single-position writes exact
+# (a whole-block scale would invalidate already-written rows).
+
+
+def quantize_rows(x):
+    """``(..., heads, head_dim)`` float rows -> (int8 rows, fp32 scales
+    shaped ``(...,)``). Symmetric per-row absmax scaling to [-127, 127]."""
+    import jax.numpy as jnp
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=(-2, -1))
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(xf / scale[..., None, None]), -127.0, 127.0)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_rows(q, scale):
+    """Invert :func:`quantize_rows`: int8 rows + per-row scales -> fp32."""
+    import jax.numpy as jnp
+    return q.astype(jnp.float32) * scale[..., None, None].astype(jnp.float32)
+
+
 class KVCacheManager:
     """Fixed paged KV arena + host-side block ledger (thread-safe).
 
     The device arrays are plain unsharded buffers shaped
-    ``(layers, num_blocks, block_tokens, heads, head_dim)``; the ledger
-    (free list + per-sequence leases) lives entirely on the host so
-    reserve/free never touch the device.
+    ``(layers, num_blocks, block_tokens, heads, head_dim)`` (plus
+    ``(layers, num_blocks, block_tokens)`` fp32 scales when quantized);
+    the ledger (free list, refcounts, prefix index, per-sequence leases)
+    lives entirely on the host so reserve/free never touch the device.
+
+    Block lifecycle::
+
+        free -> leased (refcount 1..N, shared via the prefix index)
+             -> cached (refcount 0, content still indexed; LRU)
+             -> free  (evicted under admission pressure, or de-indexed)
     """
 
     def __init__(self, *, layers: int, heads: int, head_dim: int,
-                 num_blocks: int, block_tokens: int, dtype=np.float32):
+                 num_blocks: int, block_tokens: int, dtype=np.float32,
+                 kv_dtype=None):
         if num_blocks < 2:
             raise ValueError(
                 f"num_blocks must be >= 2 (block {RESERVED_BLOCK} is "
@@ -79,17 +153,39 @@ class KVCacheManager:
         self.head_dim = int(head_dim)
         self.num_blocks = int(num_blocks)
         self.block_tokens = int(block_tokens)
-        self.dtype = np.dtype(dtype)
+        self.compute_dtype = np.dtype(dtype)
+        self.dtype = np.dtype(kv_dtype) if kv_dtype is not None \
+            else self.compute_dtype
+        self.quantized = self.dtype == np.dtype(np.int8)
         import jax.numpy as jnp
         shape = (self.layers, self.num_blocks, self.block_tokens,
                  self.heads, self.head_dim)
         self.arena_k = jnp.zeros(shape, self.dtype)
         self.arena_v = jnp.zeros(shape, self.dtype)
+        if self.quantized:
+            sshape = (self.layers, self.num_blocks, self.block_tokens)
+            self.scale_k = jnp.ones(sshape, np.float32)
+            self.scale_v = jnp.ones(sshape, np.float32)
+        else:
+            self.scale_k = self.scale_v = None
         self._lock = threading.Lock()
         # LIFO free list: recently-freed blocks are re-leased first, which
         # keeps the hot working set compact in HBM
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
         self._leases: Dict[str, List[int]] = {}
+        # prefix-reuse ledger: refcounts for leased blocks, the content
+        # index (chained hash -> block, 1:1 both ways), the LRU pool of
+        # refcount-0 blocks still holding indexed content, and per-lease
+        # reservation metadata (hit counts + pending copy-on-write)
+        self._refcount: Dict[int, int] = {}
+        self._index: Dict[str, int] = {}
+        self._block_hash: Dict[int, str] = {}
+        self._cached: "OrderedDict[int, str]" = OrderedDict()
+        self._meta: Dict[str, Dict[str, Any]] = {}
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.cow_copies = 0
+        self.prefix_evictions = 0
         self._update_gauge()
 
     # -- sizing ------------------------------------------------------------
@@ -99,52 +195,260 @@ class KVCacheManager:
         """Size the arena from the ``generate.*`` config namespace:
         ``generate.arena_mb`` when set, else enough blocks for
         ``generate.max_sequences`` sequences of ``generate.max_seq_len``
-        tokens (plus the reserved scratch block)."""
+        tokens (plus the reserved scratch block). ``generate.kv_dtype``
+        picks the storage width — at a fixed ``arena_mb``, int8 storage
+        buys roughly 2x the blocks (the capacity win the decode bench
+        lane reports)."""
         bt = int(mmlconfig.get("generate.kv_block_tokens"))
         arena_mb = float(mmlconfig.get("generate.arena_mb"))
+        cfg_dtype = str(mmlconfig.get("generate.kv_dtype")).strip().lower()
+        kv_dtype = np.dtype(cfg_dtype) if cfg_dtype else None
         if arena_mb > 0:
+            storage = kv_dtype if kv_dtype is not None else np.dtype(dtype)
             per_block = devmem.nbytes_of((2, layers, bt, heads, head_dim),
-                                         dtype)
+                                         storage)
+            if storage == np.dtype(np.int8):
+                per_block += devmem.nbytes_of((2, layers, bt), np.float32)
             num_blocks = max(2, int(arena_mb * 1e6 // per_block))
         else:
             seqs = int(mmlconfig.get("generate.max_sequences"))
             max_len = int(mmlconfig.get("generate.max_seq_len"))
             num_blocks = 1 + seqs * blocks_needed(max_len, bt)
         return cls(layers=layers, heads=heads, head_dim=head_dim,
-                   num_blocks=num_blocks, block_tokens=bt, dtype=dtype)
+                   num_blocks=num_blocks, block_tokens=bt, dtype=dtype,
+                   kv_dtype=kv_dtype)
 
     def arena_bytes(self) -> int:
-        """Total HBM footprint of both arenas (charged to the owning
+        """Total HBM footprint of both arenas at their REAL storage width,
+        plus the quantization scales when int8 (charged to the owning
         registry entry so the device-cache LRU accounts for it); the
         arithmetic itself lives in the HBM ledger (lint Rule 11)."""
-        return 2 * devmem.nbytes_of(
+        n = 2 * devmem.nbytes_of(
             (self.layers, self.num_blocks, self.block_tokens,
              self.heads, self.head_dim), self.dtype)
+        if self.quantized:
+            n += 2 * devmem.nbytes_of(
+                (self.layers, self.num_blocks, self.block_tokens),
+                np.float32)
+        return n
 
-    # -- ledger ------------------------------------------------------------
-    def try_reserve(self, seq_id: str, tokens: int) -> Optional[List[int]]:
+    def unquantized_arena_bytes(self) -> int:
+        """What the same block count would cost at the compute dtype —
+        the denominator of the int8-savings number in reports."""
+        return 2 * devmem.nbytes_of(
+            (self.layers, self.num_blocks, self.block_tokens,
+             self.heads, self.head_dim), self.compute_dtype)
+
+    # -- ledger internals (call under self._lock) --------------------------
+    def _bump(self, block: int) -> None:
+        """Take a share of ``block``: out of the cached pool if parked
+        there, refcount += 1."""
+        self._cached.pop(block, None)
+        self._refcount[block] = self._refcount.get(block, 0) + 1
+
+    def _drop(self, block: int) -> None:
+        """Release one share of ``block``; at refcount 0 it parks in the
+        cached pool (content still indexed) or returns to the free list."""
+        n = self._refcount.get(block, 0) - 1
+        if n > 0:
+            self._refcount[block] = n
+            return
+        self._refcount.pop(block, None)
+        h = self._block_hash.get(block)
+        if h is not None:
+            self._cached[block] = h
+            self._cached.move_to_end(block)
+        else:
+            self._free.append(block)
+
+    def _deindex(self, block: int) -> None:
+        h = self._block_hash.pop(block, None)
+        if h is not None:
+            self._index.pop(h, None)
+
+    def _take_fresh(self) -> Optional[int]:
+        """One content-free block: the free list first, then the LRU
+        refcount-0 cached block (its index entry dies with it)."""
+        if self._free:
+            return self._free.pop()
+        if self._cached:
+            block, _h = self._cached.popitem(last=False)
+            self._deindex(block)
+            self.prefix_evictions += 1
+            return block
+        return None
+
+    # -- reservation -------------------------------------------------------
+    def try_reserve(self, seq_id: str, tokens: int,
+                    prefix_hashes: Optional[Sequence[str]] = None,
+                    prompt_tokens: Optional[int] = None
+                    ) -> Optional[List[int]]:
         """Lease blocks covering ``tokens`` positions for ``seq_id``.
-        Returns the block ids (stable for the sequence's lifetime) or
-        None when the free list cannot cover the ask — the caller sheds
-        the request (retryable) instead of queueing into an OOM."""
+
+        With ``prefix_hashes`` (the prompt's chained full-block hashes),
+        leading blocks already in the prefix index are SHARED (refcount
+        bump) instead of drawn from the free list — the reservation only
+        pays for the uncached suffix. When the hits cover the whole
+        prompt (``prompt_tokens`` block-aligned and fully matched), the
+        final matched block is scheduled for copy-on-write instead of
+        shared writable: the joiner's first-token recompute writes into
+        position ``prompt_tokens - 1``, and no block is ever written
+        while shared (see :meth:`take_pending_cow`).
+
+        Returns the position-ordered block ids (stable for the
+        sequence's lifetime) or None when free + reclaimable-cached
+        blocks cannot cover the uncached ask — the caller sheds the
+        request (retryable) instead of queueing into an OOM.
+        """
         n = blocks_needed(tokens, self.block_tokens)
+        hashes = list(prefix_hashes or ())
         with self._lock:
             if seq_id in self._leases:
                 raise ValueError(f"sequence {seq_id!r} already holds blocks")
-            if len(self._free) < n:
-                return None
-            blocks = [self._free.pop() for _ in range(n)]
+            matched: List[int] = []
+            for h in hashes:
+                b = self._index.get(h)
+                if b is None or len(matched) >= n:
+                    break
+                matched.append(b)
+            m = len(matched)
+            full_hit = bool(hashes) and m == len(hashes) \
+                and prompt_tokens is not None \
+                and m * self.block_tokens >= int(prompt_tokens)
+            shared = matched[:-1] if full_hit else matched
+            cow_src = matched[-1] if full_hit else None
+            fresh_needed = n - len(shared)
+            reclaimable = len(self._free) + sum(
+                1 for b in self._cached if b not in matched)
+            if reclaimable < fresh_needed:
+                return None                 # nothing mutated: clean shed
+            for b in shared:
+                self._bump(b)
+            if cow_src is not None:
+                self._bump(cow_src)         # pin the copy source
+            fresh: List[int] = []
+            for _ in range(fresh_needed):
+                b = self._take_fresh()
+                assert b is not None        # guaranteed by the count above
+                self._refcount[b] = 1
+                fresh.append(b)
+            blocks = list(shared) + fresh
             self._leases[seq_id] = blocks
+            self._meta[seq_id] = {
+                "hits": m,
+                "misses": max(0, len(hashes) - m),
+                "cached_tokens": m * self.block_tokens,
+                "pending_cow": (cow_src, fresh[0]) if full_hit else None,
+            }
+            self.prefix_hits += m
+            self.prefix_misses += max(0, len(hashes) - m)
         self._update_gauge()
         return list(blocks)
 
+    def reserve_info(self, seq_id: str) -> Dict[str, Any]:
+        """Reservation metadata recorded by :meth:`try_reserve`:
+        ``hits`` / ``misses`` (prefix blocks), ``cached_tokens`` (prompt
+        positions whose K/V needs no prefill), ``pending_cow``."""
+        with self._lock:
+            meta = self._meta.get(seq_id)
+            return dict(meta) if meta else {
+                "hits": 0, "misses": 0, "cached_tokens": 0,
+                "pending_cow": None}
+
+    # -- copy-on-write -----------------------------------------------------
+    def take_pending_cow(self, seq_id: str) -> Optional[Tuple[int, int]]:
+        """The (src, dst) block copy a full-prefix-hit reservation owes
+        before its first write, or None. The caller copies src -> dst on
+        device, then calls :meth:`cow_done` to release the src pin."""
+        with self._lock:
+            meta = self._meta.get(seq_id)
+            return meta["pending_cow"] if meta else None
+
+    def cow_done(self, seq_id: str) -> None:
+        """Mark the pending copy complete: unpin the source block and
+        count the copy."""
+        with self._lock:
+            meta = self._meta.get(seq_id)
+            if not meta or not meta["pending_cow"]:
+                return
+            src, _dst = meta["pending_cow"]
+            meta["pending_cow"] = None
+            self.cow_copies += 1
+            self._drop(src)
+        self._update_gauge()
+
+    def prepare_write(self, seq_id: str, block_index: int
+                      ) -> Optional[Tuple[int, int]]:
+        """Write barrier: make the block at position ``block_index`` of
+        ``seq_id``'s lease writable.
+
+        Refcount 1: de-index it (the content is about to diverge from
+        its hash, and de-indexing inside the lock closes the race with a
+        concurrent reservation matching it) and return None — write in
+        place. Refcount > 1: allocate a fresh block, swap it into the
+        lease, release the shared one, and return ``(src, dst)`` for the
+        caller's device copy (counted as a CoW copy). Raises when no
+        block can be reclaimed — admission should have left headroom."""
+        with self._lock:
+            blocks = self._leases.get(seq_id)
+            if blocks is None:
+                raise KeyError(f"sequence {seq_id!r} holds no blocks")
+            src = blocks[block_index]
+            if self._refcount.get(src, 0) <= 1:
+                self._deindex(src)
+                return None
+            dst = self._take_fresh()
+            if dst is None:
+                raise RuntimeError(
+                    f"copy-on-write for {seq_id!r} found no reclaimable "
+                    "block; reservation accounting is broken")
+            self._refcount[dst] = 1
+            blocks[block_index] = dst
+            self.cow_copies += 1
+            self._drop(src)
+        self._update_gauge()
+        return (src, dst)
+
+    # -- prefix index ------------------------------------------------------
+    def register_prefix(self, seq_id: str, hashes: Sequence[str]) -> int:
+        """Index ``seq_id``'s leading blocks under their chained hashes
+        (called once the prompt's K/V is fully materialized). Blocks
+        whose hash is already indexed elsewhere — or that are themselves
+        already indexed — are skipped; returns how many were newly
+        indexed."""
+        added = 0
+        with self._lock:
+            blocks = self._leases.get(seq_id, ())
+            for i, h in enumerate(hashes):
+                if i >= len(blocks):
+                    break
+                b = blocks[i]
+                if h in self._index or b in self._block_hash:
+                    continue
+                self._index[h] = b
+                self._block_hash[b] = h
+                added += 1
+        return added
+
+    def block_refcount(self, block: int) -> int:
+        with self._lock:
+            return self._refcount.get(block, 0)
+
+    # -- release -----------------------------------------------------------
     def free(self, seq_id: str) -> int:
-        """Return ``seq_id``'s blocks to the free list the moment it
-        finishes; idempotent (0 when nothing was held)."""
+        """Release ``seq_id``'s shares the moment it finishes (or dies):
+        every held block drops one refcount — shared prefix blocks
+        survive for their other holders, and refcount-0 indexed blocks
+        park in the cached pool instead of the free list. Idempotent (0
+        when nothing was held)."""
         with self._lock:
             blocks = self._leases.pop(seq_id, None)
+            meta = self._meta.pop(seq_id, None)
             if blocks:
-                self._free.extend(blocks)
+                for b in blocks:
+                    self._drop(b)
+            if meta and meta.get("pending_cow"):
+                self._drop(meta["pending_cow"][0])   # unpin the src
         if not blocks:
             return 0
         self._update_gauge()
@@ -174,13 +478,23 @@ class KVCacheManager:
 
     @property
     def free_blocks(self) -> int:
+        """Blocks a reservation can draw on: truly free plus refcount-0
+        cached prefix blocks (reclaimed LRU-first on demand)."""
         with self._lock:
-            return len(self._free)
+            return len(self._free) + len(self._cached)
+
+    @property
+    def cached_blocks(self) -> int:
+        """Refcount-0 blocks parked with live prefix content."""
+        with self._lock:
+            return len(self._cached)
 
     @property
     def used_blocks(self) -> int:
+        """Distinct blocks held by at least one sequence (a shared
+        prefix block counts once, however many sequences ride it)."""
         with self._lock:
-            return sum(len(b) for b in self._leases.values())
+            return len(self._refcount)
 
     @property
     def active_sequences(self) -> int:
@@ -188,31 +502,61 @@ class KVCacheManager:
             return len(self._leases)
 
     def occupancy(self) -> float:
-        """Leased fraction of the leasable arena (the KV-occupancy gauge
+        """Held fraction of the leasable arena (the KV-occupancy gauge
         and report column)."""
         return self.used_blocks / max(1, self.leasable_blocks)
 
+    def check_conservation(self) -> bool:
+        """Ledger invariant (the property-fuzz assertion): every
+        leasable block is in exactly ONE of free / cached / refcounted,
+        and the scratch block is in none of them."""
+        with self._lock:
+            held = set(self._refcount)
+            free = set(self._free)
+            cached = set(self._cached)
+            all_blocks = held | free | cached
+            return (len(self._free) + len(self._cached) + len(held)
+                    == self.num_blocks - 1
+                    and len(all_blocks) == self.num_blocks - 1
+                    and RESERVED_BLOCK not in all_blocks
+                    and all(self._index.get(h) == b and
+                            self._block_hash.get(b) == h
+                            for b, h in list(self._cached.items())))
+
     # -- donation round-trip ----------------------------------------------
-    def swap(self, arena_k, arena_v) -> None:
-        """Store the (donated-and-returned) arena pair back after a
+    def swap(self, arena_k, arena_v, scale_k=None, scale_v=None) -> None:
+        """Store the (donated-and-returned) arena set back after a
         prefill/decode program call; the old references are dead buffers
         on donating backends."""
         self.arena_k = arena_k
         self.arena_v = arena_v
+        if scale_k is not None:
+            self.scale_k = scale_k
+        if scale_v is not None:
+            self.scale_v = scale_v
 
     def stats(self) -> Dict[str, float]:
         with self._lock:
-            used = sum(len(b) for b in self._leases.values())
+            used = len(self._refcount)
             return {
                 "blocks": self.num_blocks,
                 "block_tokens": self.block_tokens,
                 "used_blocks": used,
-                "free_blocks": len(self._free),
+                "free_blocks": len(self._free) + len(self._cached),
+                "cached_blocks": len(self._cached),
                 "sequences": len(self._leases),
                 "occupancy": used / max(1, self.num_blocks - 1),
                 "arena_bytes": self.arena_bytes(),
+                "unquantized_arena_bytes": self.unquantized_arena_bytes(),
+                "quantized": float(self.quantized),
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "cow_copies": self.cow_copies,
+                "prefix_evictions": self.prefix_evictions,
             }
 
     def _update_gauge(self) -> None:
         if metrics.metrics_enabled():
             metrics.gauge("generate.kv_occupancy").set(self.occupancy())
+            metrics.gauge("generate.kv_cached_blocks").set(
+                float(self.cached_blocks))

@@ -86,6 +86,23 @@ _DEFAULTS: Dict[str, Any] = {
                                       # from max_sequences x max_seq_len.
                                       # Accounted under
                                       # runtime.device_cache_mb either way
+    "generate.prefix_cache": True,    # shared-prefix KV reuse: hash full
+                                      # prompt blocks so N requests with
+                                      # one system prompt pay prefill once
+                                      # (refcounted blocks, copy-on-write)
+    "generate.prefill_chunk": 0,      # >0: split prompts into chunks of
+                                      # this many tokens, interleaved with
+                                      # decode steps so a long joiner never
+                                      # stalls the running batch's ITL
+    "generate.kv_dtype": "",          # "" = model dtype; "int8" stores KV
+                                      # blocks quantized (per-row scales,
+                                      # dequant fused into decode) — ~2x
+                                      # arena capacity, quality-gated
+    "generate.draft_model": "",       # registered model name proposing
+                                      # draft tokens (speculative decode);
+                                      # "" disables speculation
+    "generate.spec_tokens": 3,        # draft tokens proposed+verified per
+                                      # target step when draft_model is set
     # fleet (multi-replica router + rolling rollout; see docs/SERVING.md)
     "fleet.replicas": 2,              # in-process replicas per Fleet
     "fleet.failover_attempts": 2,     # routing tries per request (1 = no

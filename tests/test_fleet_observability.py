@@ -462,6 +462,61 @@ def test_format_bytes():
     assert format_bytes(2.34e9) == "2.3GB"
 
 
+def test_report_decode_speed_sections(tmp_path):
+    p = tmp_path / "ev-100.jsonl"
+    _write_events(p, 100, [
+        ("decode", "arena", {"model": "lm", "blocks": 64,
+                             "block_tokens": 8, "kv_dtype": "int8",
+                             "arena_bytes": 1_000_000,
+                             "unquantized_bytes": 4_000_000}),
+        ("decode", "prefix", {"model": "lm", "hits": 9, "misses": 1,
+                              "cached_tokens": 72, "cow": True}),
+        ("decode", "cow", {"model": "lm", "src": 3, "dst": 7}),
+        ("generate", "request", {"model": "lm", "prompt": 80, "tokens": 8,
+                                 "finish": "length", "ttft_ms": 5.0,
+                                 "itl_mean_ms": 1.0, "itl_max_ms": 2.0,
+                                 "total_ms": 13.0, "kv_occupancy": 0.5,
+                                 "prefix_hits": 9, "spec_proposed": 6,
+                                 "spec_accepted": 4}),
+    ])
+    rep = build_report([str(p)])
+    gv = rep["generate"]
+    assert gv["prefix_cache"] == {"hits": 9, "misses": 1, "hit_rate": 0.9,
+                                  "cached_tokens": 72, "cow_copies": 1}
+    assert gv["speculation"] == {"proposed": 6, "accepted": 4,
+                                 "accept_rate": round(4 / 6, 4)}
+    assert gv["int8_kv"] == {"arenas": 1, "arena_bytes": 1_000_000,
+                             "saved_bytes": 3_000_000}
+    text = render_report([str(p)])
+    assert "prefix cache: 90.0% hit" in text
+    assert "1 CoW copies" in text
+    assert "speculation: 66.7% accepted" in text
+    assert "int8 KV: 1 arena(s)" in text and "3.0MB saved" in text
+
+
+def test_dashboard_decode_line_from_fleet_totals():
+    dash = TopDashboard(FleetScraper([]))
+    snap = {"ts": 10.0, "scrape_ms": 0.1, "replicas": {},
+            "memory": {"total_bytes": 0, "high_watermark_bytes": 0,
+                       "by_kind": {}, "by_model": {}},
+            "fleet": {"generate.lm.prefix_hits": 18.0,
+                      "generate.lm.prefix_misses": 2.0,
+                      "generate.lm.cow_copies": 3.0,
+                      "generate.lm.spec_proposed": 10.0,
+                      "generate.lm.spec_accepted": 9.0,
+                      "generate.lm.kv.quantized": 1.0,
+                      "generate.lm.kv.arena_bytes": 1_000_000.0,
+                      "generate.lm.kv.unquantized_arena_bytes": 4_000_000.0,
+                      # kv-level hit counters must NOT double the rate
+                      "generate.lm.kv.prefix_hits": 18.0,
+                      "generate.lm.kv.prefix_misses": 2.0}}
+    frame = dash.render(snap)
+    assert "decode   prefix 90.0%  cow 3  spec 90.0%" in frame
+    assert "int8 saved 3.0MB" in frame
+    # no generate lane -> no decode line
+    assert "decode " not in dash.render(dict(snap, fleet={}))
+
+
 def test_dashboard_renders_synthetic_snapshot():
     clock = _ticker(10.0)
     good = _FlakyReplica("r0")

@@ -573,6 +573,31 @@ def test_gate_missing_fields_skip_that_check_only():
     assert [c["metric"] for c in v["lanes"]["train"]["checks"]] == ["value"]
 
 
+def test_gate_ttft_p99_gated_lower_is_better():
+    base = _line(decode=dict(_lane(), ttft_p99_ms=50.0))
+    # 20% higher tail TTFT is a regression
+    v = compare(_line(decode=dict(_lane(), ttft_p99_ms=60.0)), base)
+    assert v["red"] == ["decode"]
+    assert any("ttft_p99_ms" in r for r in v["lanes"]["decode"]["reasons"])
+    # lower tail TTFT is never a regression
+    v = compare(_line(decode=dict(_lane(), ttft_p99_ms=30.0)), base)
+    assert v["green"] is True
+
+
+def test_gate_prefix_and_spec_rates_informational_never_red():
+    base = _line(decode=dict(_lane(), prefix_hit_rate=0.99,
+                             spec_accept_rate=1.0))
+    # a cache-defeating change craters both rates — reported, not red
+    fresh = _line(decode=dict(_lane(), prefix_hit_rate=0.05,
+                              spec_accept_rate=0.1))
+    v = compare(fresh, base)
+    assert v["green"] is True
+    info = {c["metric"]: c for c in v["lanes"]["decode"]["checks"]
+            if c.get("informational")}
+    assert info["prefix_hit_rate"]["ok"] is True
+    assert info["spec_accept_rate"]["fresh"] == 0.1
+
+
 def test_load_baseline_accepts_wrapper_and_raw_forms(tmp_path):
     raw = _line(train=_lane())
     p_raw = tmp_path / "raw.json"

@@ -565,3 +565,61 @@ def test_process_rule_marker_and_non_os_receivers():
             subprocess.run(argv)  # run() is not Popen
             return p
     """), filename="mmlspark_tpu/reliability/chaos.py") == []
+
+
+# -- Rule 13: quantization arithmetic stays inside kvcache.py -----------------
+
+def test_quant_rule_flags_int8_cast_and_scale_math():
+    src = textwrap.dedent("""
+        import jax.numpy as jnp
+        import numpy as np
+
+        def rogue_quantize(x, amax):
+            scale = amax / 127.0
+            q = jnp.round(x / scale).astype(jnp.int8)
+            wide = q.astype(np.float32) * scale
+            also = x.astype("int8")
+            return q, wide, also
+    """)
+    probs = lint.check_source(
+        src, filename="mmlspark_tpu/serve/generate.py")
+    # amax/127.0 (scale math) + two int8 casts; the fp32 widening cast
+    # and the scale multiply are NOT flagged
+    assert len(probs) == 3
+    assert any("scale math" in p for p in probs)
+    assert any("quantization cast" in p for p in probs)
+    assert "allow-quant" in probs[0]            # the escape hatch is named
+    assert "serve/kvcache.py" in probs[0]       # and the scheme's home
+
+
+def test_quant_rule_scoped_to_serve_and_home_exempt():
+    src = textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def quantize_rows(x):
+            amax = jnp.max(jnp.abs(x))
+            scale = jnp.maximum(amax / 127.0, 1e-12)
+            return jnp.round(x / scale).astype(jnp.int8), scale
+    """)
+    # kvcache.py IS the sanctioned quant-scheme home
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/serve/kvcache.py") == []
+    # outside serve/ the rule does not apply (a featurizer may quantize
+    # pixels however it likes)
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/featurize/image.py") == []
+
+
+def test_quant_rule_marker_and_benign_arithmetic():
+    assert lint.check_source(textwrap.dedent("""
+        import jax.numpy as jnp
+        import numpy as np
+        from mmlspark_tpu.serve.kvcache import dequantize_rows
+
+        def sanctioned(x, q, scale):
+            y = x.astype(jnp.int8)  # lint: allow-quant
+            k = dequantize_rows(q, scale)     # the delegation spelling
+            z = x.astype(np.float32)          # widening: out of scope
+            n = 128 * 2                       # not the 127 range constant
+            return y, k, z, n
+    """), filename="mmlspark_tpu/serve/generate.py") == []
