@@ -1899,6 +1899,321 @@ def config_decode_sharedprefix() -> dict:
             "compile_ms": compile_ms}
 
 
+# -- configs "train_xl"/"decode_xl": 2-D (data x model) mesh lanes -----------
+
+# The xl lanes need a multi-device host for their 2-D mesh. On a CPU-only
+# host main() forces the host-platform device count BEFORE jax loads
+# (emulated multi-device mesh), so the same `python bench.py --configs
+# train_xl,decode_xl` line works on a laptop and on a real slice; on an
+# accelerator host the flag only touches the unused CPU platform.
+XL_DEVICES = 8
+XL_CONFIGS = ("train_xl", "decode_xl")
+
+
+def _xl_mesh_or_skip():
+    """('DATAxMODEL' shape for this host, None), or (None, skip-dict) on a
+    host that cannot form the 2-D mesh — a skip, never a crash, so the xl
+    lanes riding in the default config list can't take down the bench."""
+    import jax
+    n = jax.device_count()
+    if n < 4 or n % 2:
+        return None, {"skipped": True,
+                      "reason": f"2-D mesh needs an even device count >= 4,"
+                                f" have {n}"}
+    return f"{n // 2}x2", None
+
+
+def config_train_xl() -> dict:
+    """Crossing the single-chip HBM boundary, training side: a
+    tied-embedding transformer LM whose Adam train state (params + mu +
+    nu) EXCEEDS the emulated per-chip HBM budget, trained on the 2-D
+    (data, model) mesh selected by the ``parallel.mesh_shape`` config key
+    ('4x2' on 8 devices). Params and optimizer state shard over the model
+    axis through the same ``param_shardings`` regex rules 1-D training
+    uses; the device metrics ring keeps steady-state stepping at ZERO
+    counted host syncs between flushes (reported, gated by the acceptance
+    list); ``shard_bytes_max`` is the per-chip resident state that
+    actually fits where the unsharded state could not. Baseline: the same
+    model/batches through a single-device pure-JAX Adam loop on resident
+    data (the 1-D reference). MFU reads against the accelerator peak on
+    real hardware and null on the emulated CPU mesh."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from mmlspark_tpu.models.zoo import build_model
+    from mmlspark_tpu.observability import memory as devmem
+    from mmlspark_tpu.observability import metrics as obsmetrics
+    from mmlspark_tpu.observability import syncs as obssyncs
+    from mmlspark_tpu.parallel.trainer import (DeviceEpochCache,
+                                               DistributedTrainer)
+    from mmlspark_tpu.utils import config as mmlconfig
+
+    shape_str, skip = _xl_mesh_or_skip()
+    if skip:
+        return skip
+    bs, L, steps, n = 8, 32, 4, 32
+    vocab, dim, depth, heads = 16384, 256, 2, 8
+    # emulated per-chip HBM budget: sized so the UNSHARDED Adam state
+    # cannot fit one chip but its model-axis shard can — the boundary the
+    # lane certifies it crosses (``crosses_chip``)
+    chip_budget_mb = 48.0
+
+    rng_np = np.random.default_rng(21)
+    tokens = rng_np.integers(
+        1, vocab, size=(n, L)).astype(np.int32)
+
+    module = build_model("transformer_lm", vocab=vocab, dim=dim,
+                         depth=depth, heads=heads, max_len=L,
+                         dtype=jnp.float32)["module"]
+
+    def loss_fn(params, batch, rng):
+        import optax as _optax
+        logits = module.apply(params, batch["tokens"]).astype(jnp.float32)
+        return _optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], batch["tokens"][:, 1:]).mean()
+
+    prior = {k: mmlconfig.get(k) for k in
+             ("parallel.mesh_shape", "train.metrics_flush_steps")}
+    mmlconfig.set("parallel.mesh_shape", shape_str)
+    # flush cadence == timed-region length: exactly one ring fetch per
+    # region, so the between-flush sync count is measurable (and zero)
+    mmlconfig.set("train.metrics_flush_steps", steps)
+    try:
+        trainer = DistributedTrainer(loss_fn, optax.adam(1e-3))
+        state = trainer.init(
+            lambda: module.init(jax.random.PRNGKey(0),
+                                jnp.zeros((1, L), jnp.int32)))
+        state_bytes = devmem.param_bytes(state)
+        shard_bytes = devmem.param_shard_bytes(state)
+        rng = jax.random.PRNGKey(1)
+        cache = DeviceEpochCache({"tokens": tokens}, bs, mesh=trainer.mesh)
+
+        def batches():
+            while True:
+                yield from cache.batches(0)
+
+        it = batches()
+        state_box = [state]
+
+        def _first():
+            state_box[0], m = trainer.train_step(state_box[0], next(it), rng)
+            return m["loss"]
+        compile_ms = _timed_ms(_first)
+
+        def run_fw():
+            metrics = None
+            for _ in range(steps):
+                state_box[0], metrics = trainer.train_step(
+                    state_box[0], next(it), rng)
+            jax.device_get(metrics["loss"])
+
+        # single-device pure-JAX twin on resident batches: the 1-D
+        # reference every 2-D claim is measured against
+        opt = optax.adam(1e-3)
+
+        @jax.jit
+        def step(params, opt_state, toks):
+            def base_loss(p):
+                logits = module.apply(p, toks).astype(jnp.float32)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits[:, :-1], toks[:, 1:]).mean()
+            loss, grads = jax.value_and_grad(base_loss)(params)
+            updates, opt_state2 = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state2, loss
+
+        params = module.init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, L), jnp.int32))
+        opt_state = opt.init(params)
+        dev = [jnp.asarray(tokens[o:o + bs]) for o in range(0, n, bs)]
+        jax.block_until_ready(dev)
+        flops = _step_flops(step, params, opt_state, dev[0])
+        box = [params, opt_state]
+        box[0], box[1], loss = step(box[0], box[1], dev[0])
+        jax.device_get(loss)
+
+        def run_res():
+            loss = None
+            for i in range(steps):
+                box[0], box[1], loss = step(box[0], box[1],
+                                            dev[i % len(dev)])
+            jax.device_get(loss)
+
+        # warmup, then ONE instrumented region for the zero-sync claim:
+        # counted syncs minus ring flushes, per step — the number ROADMAP
+        # item 4 drives to zero, now measured on the 2-D mesh
+        run_fw()
+        s0 = obssyncs.total()
+        f0 = obsmetrics.counter(
+            "observability.sync_points.trainer.flush").value
+        run_fw()
+        flush_delta = (obsmetrics.counter(
+            "observability.sync_points.trainer.flush").value - f0)
+        sync_pp = max(0, obssyncs.total() - s0 - flush_delta) / steps
+
+        rounds = _robin_rounds(run_fw, run_res, trials=3, deadline_s=24.0)
+    finally:
+        for k, v in prior.items():
+            mmlconfig.set(k, v)
+    t_fw = _best(rounds, 0)
+    toks_per_s = steps * bs * L / t_fw
+    tflops, mfu = _mfu(toks_per_s, flops, bs * L)
+    budget = int(chip_budget_mb * 1e6)
+    return {"value": round(toks_per_s, 2), "unit": "tokens/sec/chip",
+            "vs_baseline": round(_med_ratio(rounds, 1, 0), 4),
+            "step_ms": round(t_fw / steps * 1e3, 3),
+            "compile_ms": compile_ms,
+            "mesh_shape": shape_str,
+            "state_bytes": int(state_bytes),
+            "shard_bytes_max": int(shard_bytes),
+            "chip_budget_mb": chip_budget_mb,
+            "crosses_chip": bool(state_bytes > budget >= shard_bytes),
+            "sync_points_per_step": round(sync_pp, 4),
+            "achieved_tflops": tflops, "mfu": mfu}
+
+
+def config_decode_xl() -> dict:
+    """Crossing the single-chip HBM boundary, serving side: the decode
+    lane with the model loaded DIRECTLY into 2-D (data, model) mesh
+    placement (``JaxModel(meshSpec=...)`` — no full replica ever
+    materializes on one chip) and the paged KV arena head-sharded along
+    the model axis, vs the SAME greedy workload on the unsharded 1-D lane
+    — which doubles as the bit-identity reference: the sharded lane's
+    token streams must match it EXACTLY (``token_identical``, the
+    acceptance gate, alongside ``steady_compiles == 0``).
+    ``shard_bytes_max`` is the per-chip resident footprint (param shards
+    + KV arena shard) the 2-D placement buys."""
+    import threading as _threading
+    import jax
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.serve import Server
+    from mmlspark_tpu.serve.batcher import bucket_for
+    from mmlspark_tpu.utils import config as mmlconfig
+
+    shape_str, skip = _xl_mesh_or_skip()
+    if skip:
+        return skip
+    mesh = f"data={jax.device_count() // 2},tensor=2"
+
+    clients, reqs_per_client, prompt_len, max_new = 8, 2, 8, 16
+    total_reqs = clients * reqs_per_client
+    # sized so the model axis has real work: 8 heads split 2-ways, and
+    # the head-sharded arena halves per-chip KV bytes
+    lm_kw = dict(dim=128, depth=2, heads=8, max_len=64)
+    keys = ("generate.max_seq_len", "generate.max_sequences",
+            "generate.kv_block_tokens", "generate.shard_kv")
+    prior = {k: mmlconfig.get(k) for k in keys}
+    mmlconfig.set("generate.max_seq_len", 64)
+    mmlconfig.set("generate.max_sequences", clients)
+    mmlconfig.set("generate.kv_block_tokens", 8)
+    mmlconfig.set("generate.shard_kv", True)
+    rng = np.random.default_rng(23)
+    prompts = rng.integers(1, 250,
+                           size=(total_reqs, prompt_len)).astype(np.int32)
+
+    sharded = Server({"lm": JaxModel(meshSpec=mesh).set_model(
+        "transformer_lm_tiny", seed=0, **lm_kw)})
+    t0 = time.perf_counter()
+    sharded.generate("lm", prompts[0].tolist(), max_new_tokens=max_new,
+                     timeout=120)
+    compile_ms = round((time.perf_counter() - t0) * 1e3, 3)
+    lane = sharded.enable_generate("lm")
+
+    base = Server({"lm": JaxModel().set_model(
+        "transformer_lm_tiny", seed=0, **lm_kw)})
+    base.generate("lm", prompts[0].tolist(), max_new_tokens=max_new,
+                  timeout=120)
+    base_lane = base.enable_generate("lm")
+    try:
+        # bit-identity: greedy token streams, sharded vs unsharded, must
+        # agree token-for-token (no seed -> greedy argmax on both lanes)
+        sh_tok = [sharded.generate("lm", prompts[i].tolist(),
+                                   max_new_tokens=max_new,
+                                   timeout=120)["tokens"]
+                  for i in range(4)]
+        un_tok = [base.generate("lm", prompts[i].tolist(),
+                                max_new_tokens=max_new,
+                                timeout=120)["tokens"]
+                  for i in range(4)]
+        token_identical = bool(sh_tok == un_tok)
+
+        def close_loop(server, ttfts):
+            errs: list = []
+
+            def client(rows):
+                for i in rows:
+                    try:
+                        out = server.generate(
+                            "lm", prompts[i].tolist(),
+                            max_new_tokens=max_new, timeout=120)
+                    except Exception as e:
+                        errs.append(e)
+                        return
+                    ttfts.append(out["ttft_ms"])
+            threads = [_threading.Thread(
+                target=client, args=(range(c, total_reqs, clients),),
+                daemon=True) for c in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errs:
+                raise errs[0]
+
+        ttfts_fw: list = []
+        ttfts_base: list = []
+
+        def run_fw():
+            close_loop(sharded, ttfts_fw)
+
+        def run_base():
+            close_loop(base, ttfts_base)
+
+        # warm every bucketed program up front so the timed region is
+        # compile-free by construction (the steady_compiles gate)
+        for ln in (lane, base_lane):
+            g = ln.gen
+            g.program_for("prefill", bucket_for(prompt_len,
+                                                g.prefill_buckets))
+            for b in g.decode_buckets:
+                g.program_for("decode", b)
+        run_fw()
+        run_base()
+        ttfts_fw.clear()
+        ttfts_base.clear()
+        compiles_warm = (lane.gen.entry.compile_count
+                         + base_lane.gen.entry.compile_count)
+        rounds = _robin_rounds(run_fw, run_base, trials=3, deadline_s=24.0)
+        steady_compiles = (lane.gen.entry.compile_count
+                           + base_lane.gen.entry.compile_count
+                           - compiles_warm)
+        shard_bytes = (sharded.registry.get("lm").resident_bytes()
+                       + lane.gen.kv.arena_shard_bytes())
+        full_bytes = (base.registry.get("lm").resident_bytes()
+                      + base_lane.gen.kv.arena_bytes())
+        kv_spec = str(getattr(lane.gen.kv.arena_sharding, "spec", None))
+    finally:
+        sharded.close()
+        base.close()
+        for k, v in prior.items():
+            mmlconfig.set(k, v)
+    t_fw = _best(rounds, 0)
+    tokens = total_reqs * max_new
+    from mmlspark_tpu.observability.metrics import nearest_rank
+    srt = sorted(ttfts_fw)
+    return {"value": round(tokens / t_fw, 2), "unit": "tokens/sec/chip",
+            "vs_baseline": round(_med_ratio(rounds, 1, 0), 4),
+            "ttft_p50_ms": round(nearest_rank(srt, 50), 3),
+            "ttft_p99_ms": round(nearest_rank(srt, 99), 3),
+            "mesh_shape": shape_str,
+            "kv_arena_spec": kv_spec,
+            "shard_bytes_max": int(shard_bytes),
+            "unsharded_bytes": int(full_bytes),
+            "token_identical": token_identical,
+            "steady_compiles": int(steady_compiles),
+            "kv_blocks": lane.gen.kv.num_blocks,
+            "compile_ms": compile_ms}
+
+
 def config_streaming_input():
     """Streamed-from-disk epoch vs fully-materialized-Frame epoch.
 
@@ -1988,6 +2303,8 @@ CONFIGS = {
     "serving": config_serving,
     "serving_fleet": config_serving_fleet,
     "decode": config_decode,
+    "train_xl": config_train_xl,
+    "decode_xl": config_decode_xl,
     "streaming_input": config_streaming_input,
 }
 
@@ -2000,8 +2317,28 @@ CONFIG_UNITS = {
     "serving_fleet": "requests/sec/chip",
     "decode": "tokens/sec/chip",
     "decode_sharedprefix": "tokens/sec/chip",
+    "train_xl": "tokens/sec/chip",
+    "decode_xl": "tokens/sec/chip",
     "streaming_input": "rows/sec",
 }
+
+
+def _force_xl_devices(names) -> None:
+    """When an xl lane is selected, raise the host-platform device count
+    BEFORE jax first loads so a CPU-only host can form the 2-D mesh
+    (``--xla_force_host_platform_device_count`` is read once at backend
+    init). A no-op when the flag is already set, when no xl lane runs, or
+    — on accelerator hosts — in effect, since the flag only shapes the
+    unused CPU platform."""
+    import os
+    if not any(n in XL_CONFIGS for n in names):
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        return
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={XL_DEVICES}"
+    ).strip()
 
 
 def _emit_bench_event(name: str, result: dict) -> None:
@@ -2034,7 +2371,6 @@ def _enable_compile_cache() -> None:
 
 
 def main() -> int:
-    _enable_compile_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default=",".join(CONFIGS),
                     help="comma list of: " + ",".join(CONFIGS))
@@ -2051,6 +2387,10 @@ def main() -> int:
 
     if not names:
         raise SystemExit("no configs selected")
+    # BEFORE the first jax import of the process (the compile-cache setup
+    # below is it): the xl lanes' emulated multi-device mesh
+    _force_xl_devices(names)
+    _enable_compile_cache()
 
     import os
     import signal

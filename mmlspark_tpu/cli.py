@@ -484,10 +484,15 @@ def cmd_fleet(args, passthrough) -> int:
                   os.path.join(events_dir, f"events-{os.getpid()}.jsonl"))
     cache_dir = args.compile_cache_dir \
         or str(mmlconfig.get("runtime.compile_cache_dir"))
+    dpw = args.devices_per_worker if args.devices_per_worker is not None \
+        else int(mmlconfig.get("fleet.devices_per_worker"))
+    if dpw < 0:
+        raise SystemExit(
+            f"fleet: --devices-per-worker must be >= 0, got {dpw}")
     spawner = ProcessSpawner(
         args.model, host=args.host, events_dir=events_dir,
         compile_cache_dir=cache_dir or None,
-        extra_args=list(passthrough))
+        extra_args=list(passthrough), devices_per_worker=dpw)
     sup = Supervisor(spawner, [f"w{i}" for i in range(replicas)])
     scraper = None
     httpd = None
@@ -551,6 +556,17 @@ def cmd_chaos(args, passthrough) -> int:
     requests, and a crash-looper ends breaker-open, not flapping.
     Writes ``chaos_verdict.json`` under --out; exit 0 iff every
     invariant held."""
+    if args.scenario.endswith("_sharded") and "jax" not in sys.modules:
+        # the 2-D mesh needs >= 4 devices: raise the host-platform count
+        # BEFORE jax first loads so a CPU-only host can form it (same
+        # seam as bench.py's xl lanes; on accelerator hosts the flag
+        # only shapes the unused CPU platform). Read once at backend
+        # init, so too late once jax is imported.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count=8"
+            ).strip()
     from mmlspark_tpu.reliability import chaos
     if args.scenario not in chaos.SCENARIOS:
         known = "\n".join(f"  {name:8s} {desc}" for name, desc
@@ -560,14 +576,18 @@ def cmd_chaos(args, passthrough) -> int:
         return 2
     outdir = args.out or os.path.join(
         os.getcwd(), f"chaos-{args.scenario}-seed{args.seed}")
-    if args.scenario == "fleet":
+    if args.scenario in ("fleet", "fleet_sharded"):
         verdict = chaos.run_fleet_scenario(
             args.seed, outdir, replicas=args.replicas,
-            requests=args.requests)
-    elif args.scenario == "decode":
+            requests=args.requests,
+            mesh=chaos.SHARDED_MESH if args.scenario.endswith("_sharded")
+            else "")
+    elif args.scenario in ("decode", "decode_sharded"):
         verdict = chaos.run_decode_scenario(
             args.seed, outdir, replicas=args.replicas,
-            requests=args.requests)
+            requests=args.requests,
+            mesh=chaos.SHARDED_MESH if args.scenario.endswith("_sharded")
+            else "")
     elif args.scenario == "host":
         verdict = chaos.run_host_scenario(
             args.seed, outdir, replicas=args.replicas,
@@ -727,6 +747,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "to every worker; restarted replicas LOAD "
                          "compiled programs instead of recompiling "
                          "(default: runtime.compile_cache_dir)")
+    fleet_p.add_argument("--devices-per-worker", type=int, default=None,
+                         help="pin each worker to K disjoint accelerator "
+                         "chips (slot i sees chips [i*K, (i+1)*K) via "
+                         "visible-devices env); 0 = no pinning, workers "
+                         "share (default: fleet.devices_per_worker "
+                         "config)")
     fleet_p.set_defaults(fn=cmd_fleet)
 
     chaos_p = sub.add_parser(

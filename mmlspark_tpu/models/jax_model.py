@@ -161,21 +161,31 @@ class JaxModel(HasInputCol, HasOutputCol, Model):
         # which for a ResNet-50/ViT-B bloats the program by the full
         # parameter size and multiplies compile time (or overflows
         # remote-compile request limits outright)
-        params = jax.tree_util.tree_map(jnp.asarray, self._state["params"])
         cdt = (jnp.bfloat16 if self.get("computeDtype") == "bfloat16"
                else None)
-        if cdt is not None:
-            params = jax.tree_util.tree_map(
-                lambda a: a.astype(cdt)
-                if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
         if mesh is not None:
-            # model-parallel scoring: params land sharded (tensor/fsdp per
-            # the standard rules) ONCE; every batch then streams through
-            # the pjit'd apply with its batch dim over the data axes
+            # model-parallel scoring: HOST numpy -> sharded device arrays
+            # in one hop (device_put against the NamedSharding tree), so
+            # each chip receives only its shard — a model bigger than one
+            # chip's HBM never materializes a full replica on any device.
+            # The bf16 cast happens on host for the same reason.
             from mmlspark_tpu.parallel.sharding import param_shardings
+            params = self._state["params"]
+            if cdt is not None:
+                params = jax.tree_util.tree_map(
+                    lambda a: a.astype(cdt)
+                    if np.issubdtype(np.asarray(a).dtype, np.floating)
+                    else np.asarray(a), params)
             with mesh:
                 params = jax.device_put(
                     params, param_shardings(params, mesh))
+        else:
+            params = jax.tree_util.tree_map(jnp.asarray,
+                                            self._state["params"])
+            if cdt is not None:
+                params = jax.tree_util.tree_map(
+                    lambda a: a.astype(cdt)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
         node = self.outputNodeName
 
         # Optional input standardization: models trained on z-scored inputs

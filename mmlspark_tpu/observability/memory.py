@@ -56,6 +56,34 @@ def param_bytes(params: Any) -> int:
                if hasattr(l, "shape") and hasattr(l, "dtype"))
 
 
+def shard_bytes_of(leaf: Any) -> int:
+    """Bytes of one array AS RESIDENT ON ONE DEVICE: the per-shard size
+    for mesh-sharded arrays, the full size otherwise. This is what the
+    HBM ledger charges for sharded models — a 2x-tensor-sharded kernel
+    costs each chip half its logical bytes."""
+    if not (hasattr(leaf, "shape") and hasattr(leaf, "dtype")):
+        return 0
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None and hasattr(sharding, "shard_shape"):
+        try:
+            return nbytes_of(sharding.shard_shape(tuple(leaf.shape)),
+                             leaf.dtype)
+        except (TypeError, ValueError):
+            pass  # abstract/odd leaves fall back to full logical bytes
+    return nbytes_of(leaf.shape, leaf.dtype)
+
+
+def param_shard_bytes(params: Any) -> int:
+    """Per-device resident bytes of a param tree: sum of each leaf's
+    :func:`shard_bytes_of`. Equal to :func:`param_bytes` for unsharded
+    trees, strictly smaller once the model axis splits kernels."""
+    if params is None:
+        return 0
+    import jax
+    return sum(shard_bytes_of(l)
+               for l in jax.tree_util.tree_leaves(params))
+
+
 class MemoryLedger:
     """Process-wide bytes-by-``{model, kind}`` map with a high-watermark.
 

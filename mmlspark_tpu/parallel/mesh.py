@@ -94,12 +94,41 @@ def parse_mesh_axes(text: str) -> Dict[str, int]:
     return axes
 
 
+def parse_mesh_shape(text: str) -> MeshSpec:
+    """'4x2' -> MeshSpec(data=4, tensor=2): the 2-D (data, model) shorthand
+    behind the ``parallel.mesh_shape`` config key. The first factor is the
+    data axis (-1 absorbs remaining devices), the second the model
+    (``tensor``) axis — placed last so per-layer collectives ride the
+    innermost ICI ring. A single factor ('8') means pure data parallel."""
+    parts = [p.strip() for p in text.lower().split("x") if p.strip()]
+    if not parts or len(parts) > 2:
+        raise ValueError(
+            f"bad mesh shape {text!r}: want 'DATAxMODEL' (e.g. '4x2') or a "
+            "single data-parallel size")
+    sizes = [int(p) for p in parts]
+    for n in sizes:
+        if n == 0 or n < -1:
+            raise ValueError(
+                f"bad size {n} in mesh shape {text!r}: want a positive "
+                "size or -1 (absorb remaining devices)")
+    if len(sizes) == 1:
+        return MeshSpec(data=sizes[0])
+    if sizes[1] == -1:
+        raise ValueError(
+            f"bad mesh shape {text!r}: only the data factor may be -1")
+    return MeshSpec(data=sizes[0], tensor=sizes[1])
+
+
 def mesh_from_config(devices: Optional[Sequence] = None) -> Mesh:
-    """Mesh from the ``runtime.mesh`` config key (set by the launcher's
-    ``--mesh data=-1,tensor=2`` flag or MMLSPARK_TPU_RUNTIME_MESH).
-    Falls back to all-devices data parallel when unset — so library code
-    can default to this and the same script scales by flag alone."""
+    """Mesh from config: ``parallel.mesh_shape`` (the 2-D 'DxT' shorthand,
+    e.g. '4x2') first, else the ``runtime.mesh`` axis-map key (set by the
+    launcher's ``--mesh data=-1,tensor=2`` flag or MMLSPARK_TPU_RUNTIME_MESH).
+    Falls back to all-devices data parallel when both are unset — so library
+    code can default to this and the same script scales by flag alone."""
     from mmlspark_tpu.utils import config
+    shape = config.get("parallel.mesh_shape", "")
+    if shape:
+        return make_mesh(parse_mesh_shape(shape), devices)
     text = config.get("runtime.mesh")
     if not text:
         return data_parallel_mesh(devices)

@@ -124,7 +124,7 @@ def _qkv_spec(mesh: Mesh, seq_axis: str, n_heads: int) -> P:
     batch = active_batch_axes(mesh)
     t = mesh.shape.get("tensor", 1)
     head = "tensor" if t > 1 and n_heads % t == 0 else None
-    return P(batch, seq_axis, head, None)
+    return P(batch, seq_axis, head, None)  # lint: allow-spec (shard_map spec)
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,

@@ -142,6 +142,40 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def tensor_axis_size(mesh: Optional[Mesh]) -> int:
+    """Size of the model (``tensor``) axis; 1 for no mesh / axis absent."""
+    return int(mesh.shape.get("tensor", 1)) if mesh is not None else 1
+
+
+def kv_arena_sharding(mesh: Mesh, heads: int) -> NamedSharding:
+    """Placement for a paged KV arena (layers, blocks, block_tokens, heads,
+    head_dim): the head axis over ``tensor`` when the model axis is
+    non-trivial and divides the head count — the same split the attention
+    projections use, so each model shard attends over exactly the heads it
+    computed, with no cross-shard gather of K/V. Otherwise replicated."""
+    t = tensor_axis_size(mesh)
+    if t > 1 and heads % t == 0:
+        return NamedSharding(mesh, P(None, None, None, "tensor", None))
+    return NamedSharding(mesh, P())
+
+
+def kv_scale_sharding(mesh: Mesh) -> NamedSharding:
+    """Quantization scales (layers, blocks, block_tokens) carry no head
+    axis — replicate them (they are ~head_dim x smaller than the arena)."""
+    return NamedSharding(mesh, P())
+
+
+def epoch_cache_sharding(mesh: Mesh, ndim: int,
+                         seq_axis: Optional[str] = None) -> NamedSharding:
+    """Placement for a device-resident epoch cache array (E, B, ...): the
+    leading epoch dim replicated, batch over the data axes, and — for >2-D
+    arrays when requested — the third (sequence) dim over ``seq``."""
+    axes = active_batch_axes(mesh)
+    if ndim > 2 and seq_axis and mesh.shape.get(seq_axis, 1) > 1:
+        return NamedSharding(mesh, P(None, axes, seq_axis))
+    return NamedSharding(mesh, P(None, axes))
+
+
 BATCH_AXES = ("data", "fsdp")
 
 

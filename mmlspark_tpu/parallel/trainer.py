@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 # DevicePrefetcher moved to data/prefetch.py (the streaming input pipeline's
 # terminal stage); re-exported here because trainer.DevicePrefetcher is the
@@ -37,8 +37,8 @@ from mmlspark_tpu.observability import syncs as obssyncs
 from mmlspark_tpu.reliability import watchdog as _watchdog
 from mmlspark_tpu.reliability.faults import fault_site
 from mmlspark_tpu.parallel.sharding import (
-    active_batch_axes, batch_sharding, is_cpu_mesh, local_batch_rows,
-    mesh_spans_processes, param_shardings, Rules, shard_batch,
+    batch_sharding, epoch_cache_sharding, is_cpu_mesh, local_batch_rows,
+    mesh_spans_processes, param_shardings, replicated, Rules, shard_batch,
 )
 from mmlspark_tpu.utils import config as mmlconfig
 from mmlspark_tpu.utils.logging import MetricLogger, get_logger
@@ -133,13 +133,8 @@ class DeviceEpochCache:
                     np.asarray(x)[:keep].reshape(
                         (self.steps_per_epoch, self.local_batch)
                         + np.asarray(x).shape[1:]))
-                axes = active_batch_axes(self.mesh)
-                if (seq_axis and x.ndim > 2
-                        and self.mesh.shape.get(seq_axis, 1) > 1):
-                    spec = P(None, axes, seq_axis)
-                else:
-                    spec = P(None, axes)
-                sharding = NamedSharding(self.mesh, spec)
+                sharding = epoch_cache_sharding(self.mesh, x.ndim,
+                                                seq_axis=seq_axis)
                 if self._spans:
                     gshape = ((self.steps_per_epoch, self.batch_size)
                               + x.shape[2:])
@@ -309,7 +304,7 @@ class DistributedTrainer:
         ring plus the step counter of the latest step written. Replicated
         on purpose — every process flushes identical values under SPMD."""
         flush = self.flush_steps()
-        repl = NamedSharding(self.mesh, P())
+        repl = replicated(self.mesh)
         with self.mesh:
             return {
                 "loss": jax.device_put(
@@ -375,8 +370,8 @@ class DistributedTrainer:
         # shardings), where each put_batch transfer is single-use — donating
         # it stops the step from double-buffering its inputs. Reused device
         # batches (DeviceEpochCache epochs) take the non-donating variant.
-        ring_shardings = {"loss": NamedSharding(self.mesh, P()),
-                          "step": NamedSharding(self.mesh, P())}
+        ring_shardings = {"loss": replicated(self.mesh),
+                          "step": replicated(self.mesh)}
         return jax.jit(
             step,
             out_shardings=(self._state_shardings, ring_shardings, None),

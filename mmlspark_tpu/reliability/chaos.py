@@ -61,7 +61,19 @@ SCENARIOS: Dict[str, str] = {
     "host": "SIGKILL a real worker PROCESS under fire; supervisor "
             "warm-restarts it from the shared compile cache, and a "
             "crash-looper ends breaker-open, not flapping",
+    "fleet_sharded": "the fleet scenario with every replica's model "
+                     "2-D mesh-sharded (data x tensor); same zero-drop "
+                     "+ bit-identical invariants through the kill",
+    "decode_sharded": "the decode scenario with a mesh-sharded model + "
+                      "head-sharded KV arena; failover token-identical "
+                      "and the HBM ledger reconciles PER SHARD",
 }
+
+# the 2-D topology the *_sharded scenarios run on: tensor=2 model axis,
+# data absorbs the rest, so the SAME string fits a 4-chip host (2x2) and
+# the CI's forced-8-CPU-device emulation (4x2) — a mesh must multiply to
+# the device count exactly
+SHARDED_MESH = "data=-1,tensor=2"
 
 # Sites the TRAIN phase draws its schedule from. `trainer.train_step` /
 # `checkpoint.save` raises are kills (the loop restarts); a
@@ -311,7 +323,8 @@ def _serve_phase(seed: int, requests: int,
 # -- fleet scenario ----------------------------------------------------------
 
 def run_fleet_scenario(seed: int, outdir: str, replicas: int = 3,
-                       requests: int = 24) -> Dict[str, Any]:
+                       requests: int = 24,
+                       mesh: str = "") -> Dict[str, Any]:
     """Kill a replica under fire; the fleet must not drop a request.
 
     1. **reference** — the full request stream scored on a single
@@ -369,8 +382,9 @@ def run_fleet_scenario(seed: int, outdir: str, replicas: int = 3,
 
     os.makedirs(outdir, exist_ok=True)
     errors: List[str] = []
-    verdict: Dict[str, Any] = {"seed": seed, "scenario": "fleet",
-                               "replicas": replicas, "requests": requests}
+    verdict: Dict[str, Any] = {
+        "seed": seed, "scenario": "fleet_sharded" if mesh else "fleet",
+        "replicas": replicas, "requests": requests, "mesh": mesh}
 
     rng = random.Random(seed ^ 0xF1EE7)
     # the kill lands right after a probe round: the next probe is then a
@@ -383,7 +397,11 @@ def run_fleet_scenario(seed: int, outdir: str, replicas: int = 3,
     kill_at = min(kill_at, max(requests - probe_every, 0))
     kill_idx = rng.randrange(replicas)
 
-    model = JaxModel(inputCol="x", outputCol="y", miniBatchSize=8)
+    # sharded variant: the SAME scenario, but every replica's copy of the
+    # model scores over a 2-D (data x tensor) mesh — the kill and the
+    # failover must not care that each chip holds only a param shard
+    model = JaxModel(inputCol="x", outputCol="y", miniBatchSize=8,
+                     **({"meshSpec": mesh} if mesh else {}))
     model.set_model("mlp_tabular", input_dim=_DIM, hidden=[16],
                     num_classes=3, seed=seed & 0xFFFF)
     xrng = np.random.default_rng(seed)
@@ -543,7 +561,8 @@ def run_fleet_scenario(seed: int, outdir: str, replicas: int = 3,
 # -- decode scenario ---------------------------------------------------------
 
 def run_decode_scenario(seed: int, outdir: str, replicas: int = 2,
-                        requests: int = 5) -> Dict[str, Any]:
+                        requests: int = 5,
+                        mesh: str = "") -> Dict[str, Any]:
     """Kill a replica mid-GENERATION; every sequence still completes.
 
     Generation raises the stakes over the scoring-fleet scenario: a
@@ -601,8 +620,9 @@ def run_decode_scenario(seed: int, outdir: str, replicas: int = 2,
 
     os.makedirs(outdir, exist_ok=True)
     errors: List[str] = []
-    verdict: Dict[str, Any] = {"seed": seed, "scenario": "decode",
-                               "replicas": replicas, "requests": requests}
+    verdict: Dict[str, Any] = {
+        "seed": seed, "scenario": "decode_sharded" if mesh else "decode",
+        "replicas": replicas, "requests": requests, "mesh": mesh}
 
     rng = random.Random(seed ^ 0xDEC0DE)
     kill_req = rng.randint(requests // 3, max(requests // 3,
@@ -621,7 +641,12 @@ def run_decode_scenario(seed: int, outdir: str, replicas: int = 2,
     mmlconfig.set("generate.max_seq_len", 64)
     mmlconfig.set("generate.max_sequences", 4)
     mmlconfig.set("generate.kv_block_tokens", 8)
-    model = JaxModel().set_model("transformer_lm_tiny", seed=seed & 0xFFFF)
+    # sharded variant: a 2-D (data x tensor) mesh-bound model whose KV
+    # arena is head-sharded over the tensor axis — the kill, failover
+    # restart, and shared-prefix ledger invariants must all hold with
+    # every chip holding only its param + KV shard
+    model = JaxModel(**({"meshSpec": mesh} if mesh else {})).set_model(
+        "transformer_lm_tiny", seed=seed & 0xFFFF)
 
     reference: List[List[int]] = []
     results: List[Optional[Dict[str, Any]]] = []
@@ -853,7 +878,11 @@ def _run_shared_prefix_kill(model, rng, seed: int,
         identical = (toks_a == ref_a) and (toks_b == ref_b)
         reconciled = kv.used_blocks == 0 and kv.check_conservation()
         charged1 = ledger.total(model="lm", kind="kv")
-        leak_ok = charged1 == kv.arena_bytes() and charged1 == charged0
+        # per-SHARD footprint: for a head-sharded arena (decode_sharded)
+        # the ledger charges what one chip actually holds, not the
+        # logical total; equal to arena_bytes() when unsharded
+        leak_ok = (charged1 == kv.arena_shard_bytes()
+                   and charged1 == charged0)
         stats = {k: v for k, v in lane.stats().items()
                  if k.startswith(("prefix", "cow", "kv."))}
     except Exception as e:

@@ -98,6 +98,19 @@ scale formula in a program builder silently diverges from the arena's
 Quant math goes through the ``kvcache`` helpers; deliberate exceptions
 mark the line ``# lint: allow-quant``.
 
+Rule 14 — ``PartitionSpec`` / ``NamedSharding`` construction (including
+the ``P(...)`` alias) outside ``parallel/sharding.py`` /
+``parallel/mesh.py``: placement decisions live in ONE home so the 2-D
+``(data, model)`` mesh mode can change topology without auditing every
+module — an open-coded spec in a trainer or the serving lane silently
+disagrees with the param-sharding rules (axis names, divisibility
+clamps) and either crashes at dispatch or replicates a tensor the mesh
+was supposed to split. Route through the sharding helpers
+(``param_shardings``, ``replicated``, ``kv_arena_sharding``,
+``epoch_cache_sharding``, ...); genuinely local spec construction (e.g.
+``shard_map`` in/out specs naming module-private axes) marks the line
+``# lint: allow-spec``.
+
 Shared core for ``tools/check_reliability.py`` (standalone CLI),
 ``mmlspark-tpu check`` (installed CLI), and the in-pytest gate
 (tests/test_reliability_lint.py) — same single source of truth pattern as
@@ -176,6 +189,11 @@ _ALLOW_QUANT = "# lint: allow-quant"
 # (it owns quantize_rows/dequantize_rows — the single scheme every
 # arena reader and writer must share)
 _QUANT_HOME = "serve/kvcache.py"
+_ALLOW_SPEC = "# lint: allow-spec"
+# the modules allowed to construct placement specs directly (they ARE the
+# sharding policy: the rule table, the topology resolver)
+_SPEC_HOMES = ("parallel/sharding.py", "parallel/mesh.py")
+_SPEC_CTORS = ("PartitionSpec", "NamedSharding")
 
 
 def _is_raw_sync(call: ast.Call) -> bool:
@@ -298,6 +316,19 @@ def _is_quant_scale_math(node: ast.BinOp) -> bool:
     return _is_range(node.left) or _is_range(node.right)
 
 
+def _is_spec_ctor(call: ast.Call) -> bool:
+    """``PartitionSpec(...)`` / ``NamedSharding(...)`` in any spelling
+    (bare name, ``jax.sharding.``-qualified, or the conventional
+    ``P(...)`` alias) — a placement decision being made at the call
+    site. A bare ``P`` name call is only ever the PartitionSpec alias
+    in this codebase; Rule 14's scope is library code, where that
+    convention holds."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id in _SPEC_CTORS or f.id == "P"
+    return isinstance(f, ast.Attribute) and f.attr in _SPEC_CTORS
+
+
 def _is_signal_signal(call: ast.Call) -> bool:
     """``signal.signal(...)`` (or any ``<x>.signal(...)`` attribute call on
     a name ending in ``signal``) — the handler-installation form. A bare
@@ -328,6 +359,8 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     process_home = norm.endswith(_PROCESS_HOME)
     # Rule 13 scope: serve/ modules only, the quant-scheme home exempt
     quant_scoped = "serve/" in norm and not norm.endswith(_QUANT_HOME)
+    # Rule 14 scope: everywhere, the sharding-policy homes exempt
+    spec_scoped = not any(norm.endswith(h) for h in _SPEC_HOMES)
 
     def _allowed(lineno: int) -> bool:
         # marker anywhere on the offending line opts that line out
@@ -365,6 +398,10 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     def _quant_allowed(lineno: int) -> bool:
         return (0 < lineno <= len(lines)
                 and _ALLOW_QUANT in lines[lineno - 1])
+
+    def _spec_allowed(lineno: int) -> bool:
+        return (0 < lineno <= len(lines)
+                and _ALLOW_SPEC in lines[lineno - 1])
 
     for node in ast.walk(tree):
         if (isinstance(node, ast.Call)
@@ -476,6 +513,16 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
                 "agree bit-for-bit; route through kvcache."
                 "quantize_rows/dequantize_rows, or mark the line "
                 f"`{_ALLOW_QUANT}`)")
+        elif (isinstance(node, ast.Call) and spec_scoped
+                and _is_spec_ctor(node)
+                and not _spec_allowed(node.lineno)):
+            problems.append(
+                f"{filename}:{node.lineno}: PartitionSpec/NamedSharding "
+                f"construction outside {'/'.join(_SPEC_HOMES)} (placement "
+                "policy lives in ONE home so mesh topology can change "
+                "without auditing every module; route through the "
+                "sharding helpers, or mark the line "
+                f"`{_ALLOW_SPEC}`)")
         elif (isinstance(node, ast.Call) and _is_raw_sync(node)
                 and not sync_home
                 and not _sync_allowed(node.lineno)):

@@ -199,7 +199,8 @@ class _Seq:
                  "seed", "eos_id", "future", "trace_id", "enqueued",
                  "deadline", "generated", "ttft_s", "last_t", "itl_s",
                  "finish", "prefill_pos", "hashes", "spec_ok",
-                 "spec_proposed", "spec_accepted", "prefix_hits")
+                 "spec_proposed", "spec_accepted", "prefix_hits",
+                 "draft_hashes")
 
     def __init__(self, seq_id: str, req: GenerateRequest, future: Future,
                  enqueued: float, deadline: Optional[float]):
@@ -225,6 +226,7 @@ class _Seq:
         self.spec_proposed = 0          # speculation for this sequence
         self.spec_accepted = 0
         self.prefix_hits = 0            # prefix blocks shared at reserve
+        self.draft_hashes: List[str] = []  # draft-arena prefix hashes
 
     @property
     def seq_len(self) -> int:
@@ -350,10 +352,10 @@ class GenerativeEntry:
                  max_sequences: Optional[int] = None):
         self.entry = entry
         apply = entry.ensure_apply()
-        if getattr(apply, "_mesh", None) is not None:
-            raise ValueError(
-                "generative lane needs a single-device model; "
-                f"{entry.name!r} is mesh-bound")
+        # mesh-bound models decode too: params stay in their (tensor/fsdp)
+        # placement and the KV arena below joins them on the same mesh, so
+        # a model bigger than one chip's HBM serves the generative lane
+        self.mesh = getattr(apply, "_mesh", None)
         spec = entry.model._spec()
         module = spec.get("module")
         for attr in ("vocab", "dim", "depth", "heads", "max_len"):
@@ -378,7 +380,8 @@ class GenerativeEntry:
             else mmlconfig.get("generate.max_sequences"))
         self.kv = KVCacheManager.from_config(
             layers=self.depth, heads=self.heads, head_dim=self.head_dim,
-            dtype=np.dtype(self.dtype))
+            dtype=np.dtype(self.dtype), mesh=self.mesh,
+            shard_heads=bool(mmlconfig.get("generate.shard_kv")))
         self.block_tokens = self.kv.block_tokens
         # block-table width: every sequence's table is padded to the
         # blocks a max-length sequence needs, so ONE decode program shape
@@ -402,8 +405,10 @@ class GenerativeEntry:
         self.spec_width = self.spec_tokens + 1
         self._programs: Dict[Tuple[str, int], Callable] = {}
         # the arena is HBM this model now pins: charge it to the registry
-        # entry so the device-cache LRU sees params + arena as one tenant
-        entry.kv_arena_bytes = self.kv.arena_bytes()
+        # entry so the device-cache LRU sees params + arena as one tenant.
+        # PER-SHARD bytes: a head-sharded arena costs each chip 1/|tensor|
+        # of the logical total, and that is what the budget must see.
+        entry.kv_arena_bytes = self.kv.arena_shard_bytes()
 
     # -- compile seam ------------------------------------------------------
     def program_for(self, kind: str, bucket: int) -> Callable:
@@ -437,6 +442,14 @@ class GenerativeEntry:
                      f"|dtype={self.kv.dtype.name}")
         if kind == "verify":
             shape_key += f"|C={self.spec_width}"
+        if self.mesh is not None:
+            # mesh identity: the same bucket lowered for a different
+            # topology (or head-sharded vs replicated arena) is a
+            # DIFFERENT executable — its input shardings are baked in
+            axes = ",".join(f"{a}{n}" for a, n in self.mesh.shape.items()
+                            if n > 1) or "1"
+            spec = getattr(self.kv.arena_sharding, "spec", ())
+            shape_key += f"|mesh={axes}|kvspec={tuple(spec)!r}"
         result = compile_cache.load_or_compile_program(
             self.entry.name, self.entry.version, kind, shape_key,
             jitted, self.params, *abstract)
@@ -449,11 +462,23 @@ class GenerativeEntry:
     def _arena_abstract(self):
         """The arena operand placeholders every program takes right after
         ``params`` — (k, v) plus the two fp32 scale planes when int8 —
-        and the matching ``donate_argnums``."""
+        and the matching ``donate_argnums``. On a mesh the placeholders
+        carry the arena's NamedSharding: an AOT-compiled executable
+        rejects committed inputs whose sharding differs from what it was
+        lowered with, so the placement must be part of the lowering."""
         import jax
-        arena = jax.ShapeDtypeStruct(self.kv.arena_k.shape, self.kv.dtype)
-        if self.kv.quantized:
-            sc = jax.ShapeDtypeStruct(self.kv.scale_k.shape, np.float32)
+        kv = self.kv
+        if kv.mesh is not None:
+            arena = jax.ShapeDtypeStruct(kv.arena_k.shape, kv.dtype,
+                                         sharding=kv.arena_sharding)
+            if kv.quantized:
+                sc = jax.ShapeDtypeStruct(kv.scale_k.shape, np.float32,
+                                          sharding=kv.scale_sharding)
+                return (arena, arena, sc, sc), (1, 2, 3, 4)
+            return (arena, arena), (1, 2)
+        arena = jax.ShapeDtypeStruct(kv.arena_k.shape, kv.dtype)
+        if kv.quantized:
+            sc = jax.ShapeDtypeStruct(kv.scale_k.shape, np.float32)
             return (arena, arena, sc, sc), (1, 2, 3, 4)
         return (arena, arena), (1, 2)
 
@@ -937,6 +962,7 @@ class GenerateLane:
         self._cow_copies = server._twin("generate.cow_copies")
         self._spec_proposed = server._twin("generate.spec_proposed")
         self._spec_accepted = server._twin("generate.spec_accepted")
+        self._draft_prefix_hits = server._twin("generate.draft_prefix_hits")
         self.steps = 0          # decode steps taken (chaos kill trigger)
         if events.recording_enabled():
             kv = self.gen.kv
@@ -1063,9 +1089,23 @@ class GenerateLane:
             self._prefix_misses.inc(info["misses"])
         if self.draft is not None:
             # best-effort: a full draft arena only disables speculation
-            # for this sequence, it never sheds the request
+            # for this sequence, it never sheds the request. The draft
+            # reservation goes through the SAME prefix-matching admission
+            # as the target's, keyed by the draft's own (name, dtype) —
+            # a repeated prompt skips the draft prefill compute too.
+            dhashes: List[str] = []
+            if self.draft.prefix_cache:
+                dhashes = prefix_block_hashes(
+                    self.draft.entry.name, self.draft.kv.dtype.name,
+                    prompt, self.draft.block_tokens)
             seq.spec_ok = self.draft.kv.try_reserve(
-                seq_id, span_tokens) is not None
+                seq_id, span_tokens, prefix_hashes=dhashes,
+                prompt_tokens=int(prompt.size)) is not None
+            if seq.spec_ok:
+                seq.draft_hashes = dhashes
+                dhits = int(self.draft.kv.reserve_info(seq_id)["hits"])
+                if dhits:
+                    self._draft_prefix_hits.inc(dhits)
         if hashes and events.recording_enabled():
             events.emit("decode", "prefix", model=self.model,
                         hits=int(info["hits"]), misses=int(info["misses"]),
@@ -1331,20 +1371,52 @@ class GenerateLane:
     # -- speculative decoding ----------------------------------------------
     def _draft_prefill(self, seq: _Seq) -> None:
         """Materialize the draft model's KV for the prompt. Failure only
-        degrades the sequence to non-speculative decode."""
+        degrades the sequence to non-speculative decode.
+
+        Mirrors the target's prefix-reuse admission: cached leading
+        blocks (shared via the draft ledger's prefix chain) are NOT
+        recomputed — only the uncached suffix runs, through the draft's
+        chunk program, and a pending copy-on-write resolves before the
+        first write, exactly like :meth:`_admit_one` does for the
+        target. The legacy whole-prompt prefill scatters EVERY leading
+        block, so any reservation with cached blocks must take the
+        suffix path."""
         if self.draft is None or not seq.spec_ok:
             return
         d = self.draft
         try:
             Lp = int(seq.prompt.size)
-            bucket = bucket_for(Lp, d.prefill_buckets)
-            nb = bucket // d.block_tokens
-            tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :Lp] = seq.prompt
-            block_ids = np.asarray(d.kv.blocks_for(seq.seq_id)[:nb],
-                                   np.int32)
-            program = d.program_for("prefill", bucket)
-            self._call(d, program, tokens, np.int32(Lp - 1), block_ids)
+            info = d.kv.reserve_info(seq.seq_id)
+            cached = min(int(info["cached_tokens"]), Lp)
+            cow = d.kv.take_pending_cow(seq.seq_id)
+            if cow is not None:
+                self._cow_copy(d, cow)
+                d.kv.cow_done(seq.seq_id)
+            if cached > 0:
+                # suffix-only: recompute from the first uncached
+                # position (a FULL hit redoes just the last one)
+                C = d.chunk_width
+                start = min(cached, Lp - 1)
+                while start < Lp:
+                    n_valid = min(C, Lp - start)
+                    tokens = np.zeros((C,), np.int32)
+                    tokens[:n_valid] = seq.prompt[start:start + n_valid]
+                    positions = (start + np.arange(C)).astype(np.int32)
+                    table_row = d.kv.block_table(seq.seq_id, d.table_width)
+                    self._call(d, d.program_for("chunk", C), tokens,
+                               positions, table_row, np.int32(n_valid))
+                    start += n_valid
+            else:
+                bucket = bucket_for(Lp, d.prefill_buckets)
+                nb = bucket // d.block_tokens
+                tokens = np.zeros((1, bucket), np.int32)
+                tokens[0, :Lp] = seq.prompt
+                block_ids = np.asarray(d.kv.blocks_for(seq.seq_id)[:nb],
+                                       np.int32)
+                program = d.program_for("prefill", bucket)
+                self._call(d, program, tokens, np.int32(Lp - 1), block_ids)
+            if seq.draft_hashes:
+                d.kv.register_prefix(seq.seq_id, seq.draft_hashes)
         except Exception as e:
             logger.warning("draft prefill failed for %s (speculation off "
                            "for this sequence): %s", seq.seq_id, e)
@@ -1575,4 +1647,5 @@ class GenerateLane:
         if self.draft is not None:
             s["draft.kv.used_blocks"] = self.draft.kv.used_blocks
             s["draft.kv.free_blocks"] = self.draft.kv.free_blocks
+            s["draft_prefix_hits"] = self._draft_prefix_hits.value
         return s

@@ -126,11 +126,14 @@ def dequantize_rows(q, scale):
 class KVCacheManager:
     """Fixed paged KV arena + host-side block ledger (thread-safe).
 
-    The device arrays are plain unsharded buffers shaped
+    The device arrays are buffers shaped
     ``(layers, num_blocks, block_tokens, heads, head_dim)`` (plus
-    ``(layers, num_blocks, block_tokens)`` fp32 scales when quantized);
+    ``(layers, num_blocks, block_tokens)`` fp32 scales when quantized) —
+    single-device by default, or with the HEAD axis sharded over the
+    ``tensor`` mesh axis when a model mesh is passed (big-model decode);
     the ledger (free list, refcounts, prefix index, per-sequence leases)
-    lives entirely on the host so reserve/free never touch the device.
+    lives entirely on the host, is shard-agnostic, and never touches the
+    device on reserve/free either way.
 
     Block lifecycle::
 
@@ -141,7 +144,7 @@ class KVCacheManager:
 
     def __init__(self, *, layers: int, heads: int, head_dim: int,
                  num_blocks: int, block_tokens: int, dtype=np.float32,
-                 kv_dtype=None):
+                 kv_dtype=None, mesh=None, shard_heads: bool = True):
         if num_blocks < 2:
             raise ValueError(
                 f"num_blocks must be >= 2 (block {RESERVED_BLOCK} is "
@@ -160,12 +163,41 @@ class KVCacheManager:
         import jax.numpy as jnp
         shape = (self.layers, self.num_blocks, self.block_tokens,
                  self.heads, self.head_dim)
-        self.arena_k = jnp.zeros(shape, self.dtype)
-        self.arena_v = jnp.zeros(shape, self.dtype)
+        # mesh placement: the head axis shards over `tensor` (the same
+        # split the attention projections use), everything else — and the
+        # whole host-side ledger below — is shard-agnostic. Zeros are
+        # device_put from host so each chip only ever allocates its shard.
+        self.mesh = mesh
+        if mesh is not None:
+            import jax
+            from mmlspark_tpu.parallel.sharding import (
+                kv_arena_sharding, kv_scale_sharding, replicated,
+            )
+            # a mesh-bound model's arena MUST live on that mesh either
+            # way (mixed-placement operands don't compose in one
+            # program); shard_heads=False keeps it replicated there
+            self.arena_sharding = kv_arena_sharding(mesh, self.heads) \
+                if shard_heads else replicated(mesh)
+            self.scale_sharding = kv_scale_sharding(mesh)
+            self.arena_k = jax.device_put(np.zeros(shape, self.dtype),
+                                          self.arena_sharding)
+            self.arena_v = jax.device_put(np.zeros(shape, self.dtype),
+                                          self.arena_sharding)
+        else:
+            self.arena_sharding = self.scale_sharding = None
+            self.arena_k = jnp.zeros(shape, self.dtype)
+            self.arena_v = jnp.zeros(shape, self.dtype)
         if self.quantized:
             sshape = (self.layers, self.num_blocks, self.block_tokens)
-            self.scale_k = jnp.ones(sshape, np.float32)
-            self.scale_v = jnp.ones(sshape, np.float32)
+            if mesh is not None:
+                import jax
+                self.scale_k = jax.device_put(np.ones(sshape, np.float32),
+                                              self.scale_sharding)
+                self.scale_v = jax.device_put(np.ones(sshape, np.float32),
+                                              self.scale_sharding)
+            else:
+                self.scale_k = jnp.ones(sshape, np.float32)
+                self.scale_v = jnp.ones(sshape, np.float32)
         else:
             self.scale_k = self.scale_v = None
         self._lock = threading.Lock()
@@ -191,7 +223,8 @@ class KVCacheManager:
     # -- sizing ------------------------------------------------------------
     @classmethod
     def from_config(cls, *, layers: int, heads: int, head_dim: int,
-                    dtype=np.float32) -> "KVCacheManager":
+                    dtype=np.float32, mesh=None,
+                    shard_heads: bool = True) -> "KVCacheManager":
         """Size the arena from the ``generate.*`` config namespace:
         ``generate.arena_mb`` when set, else enough blocks for
         ``generate.max_sequences`` sequences of ``generate.max_seq_len``
@@ -216,7 +249,7 @@ class KVCacheManager:
             num_blocks = 1 + seqs * blocks_needed(max_len, bt)
         return cls(layers=layers, heads=heads, head_dim=head_dim,
                    num_blocks=num_blocks, block_tokens=bt, dtype=dtype,
-                   kv_dtype=kv_dtype)
+                   kv_dtype=kv_dtype, mesh=mesh, shard_heads=shard_heads)
 
     def arena_bytes(self) -> int:
         """Total HBM footprint of both arenas at their REAL storage width,
@@ -238,6 +271,23 @@ class KVCacheManager:
         return 2 * devmem.nbytes_of(
             (self.layers, self.num_blocks, self.block_tokens,
              self.heads, self.head_dim), self.compute_dtype)
+
+    def arena_shard_bytes(self) -> int:
+        """PER-DEVICE HBM footprint: each chip holds 1/|tensor| of the
+        head axis when the arena is mesh-sharded (scales stay replicated),
+        the full arena otherwise. This — not :meth:`arena_bytes` — is what
+        the registry charges against ``runtime.device_cache_mb``."""
+        if self.arena_sharding is None:
+            return self.arena_bytes()
+        n = 2 * devmem.nbytes_of(
+            self.arena_sharding.shard_shape(
+                (self.layers, self.num_blocks, self.block_tokens,
+                 self.heads, self.head_dim)), self.dtype)
+        if self.quantized:
+            n += 2 * devmem.nbytes_of(
+                (self.layers, self.num_blocks, self.block_tokens),
+                np.float32)
+        return n
 
     # -- ledger internals (call under self._lock) --------------------------
     def _bump(self, block: int) -> None:
@@ -547,6 +597,7 @@ class KVCacheManager:
                 "sequences": len(self._leases),
                 "occupancy": used / max(1, self.num_blocks - 1),
                 "arena_bytes": self.arena_bytes(),
+                "arena_shard_bytes": self.arena_shard_bytes(),
                 "unquantized_arena_bytes": self.unquantized_arena_bytes(),
                 "quantized": float(self.quantized),
                 "prefix_hits": self.prefix_hits,

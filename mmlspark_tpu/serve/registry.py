@@ -34,8 +34,10 @@ from mmlspark_tpu.reliability.breaker import CircuitBreaker
 from mmlspark_tpu.utils import config as mmlconfig
 
 # size arithmetic lives in the HBM ledger (lint Rule 11); this alias keeps
-# the registry's historical spelling working
-_param_bytes = devmem.param_bytes
+# the registry's historical spelling working. Per-SHARD bytes: a model
+# sharded over the tensor axis pins only its shard on each chip, and the
+# LRU budget / fleet HBM view must see that, not the logical total.
+_param_bytes = devmem.param_shard_bytes
 
 
 class ModelEntry:
@@ -257,7 +259,7 @@ class ModelRegistry:
         for name, apply, kv in warm:
             params = getattr(apply, "_params", None) if apply is not None \
                 else None
-            ledger.set_bytes(name, "params", devmem.param_bytes(params))
+            ledger.set_bytes(name, "params", devmem.param_shard_bytes(params))
             ledger.set_bytes(name, "kv", kv)
 
     def _resident(self) -> int:

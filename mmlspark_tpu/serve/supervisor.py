@@ -166,7 +166,8 @@ class ProcessSpawner:
                  events_dir: str = "",
                  compile_cache_dir: Optional[str] = None,
                  extra_args: Sequence[str] = (),
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 devices_per_worker: int = 0):
         if not model_flags:
             raise ValueError("spawner needs at least one --model flag")
         self.model_flags = list(model_flags)
@@ -175,6 +176,11 @@ class ProcessSpawner:
         self.compile_cache_dir = compile_cache_dir
         self.extra_args = list(extra_args)
         self.env = dict(env or {})
+        self.devices_per_worker = int(devices_per_worker)
+        # stable name -> slot assignment: a restarted replica keeps ITS
+        # chips (first spawn claims the next slot, every respawn reuses
+        # it), so two workers never share a chip across restarts
+        self._slots: Dict[str, int] = {}
 
     def build_argv(self, name: str) -> List[str]:
         argv = [sys.executable, "-m", "mmlspark_tpu.cli", "serve",
@@ -186,7 +192,30 @@ class ProcessSpawner:
         argv += self.extra_args
         return argv
 
-    def build_env(self) -> Dict[str, str]:
+    def slot_of(self, name: str) -> int:
+        """The worker's stable slot index (assigned at first spawn)."""
+        slot = self._slots.get(name)
+        if slot is None:
+            slot = len(self._slots)
+            self._slots[name] = slot
+        return slot
+
+    def device_env(self, name: str) -> Dict[str, str]:
+        """Per-worker accelerator pinning: with ``devices_per_worker=K``,
+        slot ``i`` sees chips ``[i*K, (i+1)*K)`` — disjoint visible-device
+        sets, so N single-host workers split the host's chips instead of
+        all fighting over chip 0 (the JAX default when every process sees
+        every device). Exported in every runtime's spelling; platforms
+        ignore the vars they don't read. 0 = no pinning (workers share)."""
+        k = self.devices_per_worker
+        if k <= 0:
+            return {}
+        chips = ",".join(str(self.slot_of(name) * k + j) for j in range(k))
+        return {"TPU_VISIBLE_CHIPS": chips,
+                "CUDA_VISIBLE_DEVICES": chips,
+                "HIP_VISIBLE_DEVICES": chips}
+
+    def build_env(self, name: Optional[str] = None) -> Dict[str, str]:
         from mmlspark_tpu import compile_cache
         env = dict(os.environ)
         import mmlspark_tpu as _pkg
@@ -197,6 +226,8 @@ class ProcessSpawner:
             else pkg_root
         env["PYTHONUNBUFFERED"] = "1"
         env.update(compile_cache.worker_env(self.compile_cache_dir))
+        if name is not None:
+            env.update(self.device_env(name))
         env.update(self.env)
         return env
 
@@ -206,7 +237,7 @@ class ProcessSpawner:
             os.makedirs(self.events_dir, exist_ok=True)
             log_path = os.path.join(self.events_dir, f"worker-{name}.log")
         return ProcessWorker(name, self.build_argv(name),
-                             env=self.build_env(), log_path=log_path)
+                             env=self.build_env(name), log_path=log_path)
 
 
 class _ReplicaState:

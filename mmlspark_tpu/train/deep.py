@@ -556,11 +556,11 @@ class _DeepEstimatorBase(JaxEstimator):
             # gather fsdp-sharded params into fully-replicated arrays so
             # every process can fetch the fitted model without touching
             # non-addressable shards
-            from jax.sharding import NamedSharding, PartitionSpec
+            from mmlspark_tpu.parallel.sharding import replicated
             with mesh:
                 params = jax.jit(
                     lambda p: p,
-                    out_shardings=NamedSharding(mesh, PartitionSpec()))(params)
+                    out_shardings=replicated(mesh))(params)
         params_host = obssyncs.device_get(params, "deep.fetch_params")
         from mmlspark_tpu.models.jax_model import _to_plain
         state_arrays = {

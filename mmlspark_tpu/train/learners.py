@@ -278,8 +278,8 @@ def _stream_adam(loss_fn: Callable, params: Any, frame: Frame,
         # commit state to the cache's mesh up front: otherwise step 1 runs
         # with uncommitted params, step 2 with committed outputs — two
         # compiles of the same step
-        from jax.sharding import NamedSharding, PartitionSpec
-        rep = NamedSharding(one_dev, PartitionSpec())
+        from mmlspark_tpu.parallel.sharding import replicated
+        rep = replicated(one_dev)
         params = jax.device_put(params, rep)
         opt_state = jax.device_put(opt_state, rep)
         epoch_i = 0

@@ -103,6 +103,15 @@ _DEFAULTS: Dict[str, Any] = {
                                       # "" disables speculation
     "generate.spec_tokens": 3,        # draft tokens proposed+verified per
                                       # target step when draft_model is set
+    "generate.shard_kv": True,        # on a tensor-parallel model mesh,
+                                      # shard the KV arena's head axis over
+                                      # `tensor` (requires heads % |tensor|
+                                      # == 0); False keeps it replicated
+    # parallel (mesh topology; parallel/mesh.py — see docs/PERFORMANCE.md
+    # "2-D data x model mesh")
+    "parallel.mesh_shape": "",        # "DxT" shorthand, e.g. "4x2" =
+                                      # data=4, tensor=2. Takes precedence
+                                      # over runtime.mesh; "" defers to it
     # fleet (multi-replica router + rolling rollout; see docs/SERVING.md)
     "fleet.replicas": 2,              # in-process replicas per Fleet
     "fleet.failover_attempts": 2,     # routing tries per request (1 = no
@@ -127,6 +136,11 @@ _DEFAULTS: Dict[str, Any] = {
                                              # replica out of rotation
     "fleet.supervisor_breaker_reset_s": 60.0,  # open -> one probe respawn
     "fleet.supervisor_poll_s": 0.2,          # monitor thread cadence
+    "fleet.devices_per_worker": 0,    # >0: each spawned worker process is
+                                      # pinned to its own disjoint block of
+                                      # K local chips via a per-slot
+                                      # visible-devices env (CLI:
+                                      # `fleet --devices-per-worker K`)
     # logging
     "logging.level": "INFO",
     "logging.metrics_every": 0,       # default train-metric log cadence (steps)

@@ -277,6 +277,30 @@ def test_speculative_seeded_sampling_replays_identically():
     assert run(spec=True) == run(spec=False)
 
 
+def test_draft_side_prefix_reuse_counter():
+    """The draft arena reuses shared-prefix blocks too: the second
+    sequence over the same system prompt re-leases the draft's cached
+    blocks, counted by ``generate.draft_prefix_hits`` — and reuse on
+    BOTH arenas keeps greedy output bit-identical."""
+    srv = _spec_server(draft_seed=0)
+    try:
+        lane = srv.enable_generate("lm", start=False)
+        p0 = SYSTEM + [77]
+        f0 = srv.submit_generate("lm", p0, max_new_tokens=4)
+        out0, = _run_lane(srv, lane, [f0])
+        assert lane.stats()["draft_prefix_hits"] == 0   # cold draft arena
+        p1 = SYSTEM + [88, 89]                  # shares 3 full blocks
+        f1 = srv.submit_generate("lm", p1, max_new_tokens=4)
+        out1, = _run_lane(srv, lane, [f1])
+        st = lane.stats()
+        assert st["draft_prefix_hits"] >= 3
+        assert st["draft_prefix_hits"] <= st["prefix_hits"]
+        assert out0["tokens"] == _reference_greedy(srv, "lm", p0, 4)
+        assert out1["tokens"] == _reference_greedy(srv, "lm", p1, 4)
+    finally:
+        srv.close()
+
+
 def test_speculation_skipped_when_draft_arena_sheds():
     """Draft-side reservation is best-effort: when the draft arena has
     no room the sequence decodes unspeculated instead of shedding."""

@@ -623,3 +623,49 @@ def test_quant_rule_marker_and_benign_arithmetic():
             n = 128 * 2                       # not the 127 range constant
             return y, k, z, n
     """), filename="mmlspark_tpu/serve/generate.py") == []
+
+
+# -- Rule 14: placement specs stay inside parallel/sharding.py + mesh.py ------
+
+def test_spec_rule_flags_open_coded_partition_specs():
+    src = textwrap.dedent("""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        from jax.sharding import PartitionSpec as P
+
+        def rogue_placement(mesh):
+            spec = PartitionSpec("data", None)
+            alias = P(None, "tensor")
+            qualified = jax.sharding.PartitionSpec("data")
+            return NamedSharding(mesh, spec), alias, qualified
+    """)
+    probs = lint.check_source(
+        src, filename="mmlspark_tpu/serve/generate.py")
+    # PartitionSpec(...), P(...), jax.sharding.PartitionSpec(...), and
+    # NamedSharding(...) are each a placement decision at the call site
+    assert len(probs) == 4
+    assert "allow-spec" in probs[0]             # the escape hatch is named
+    assert "parallel/sharding.py" in probs[0]   # and the policy homes
+    assert "parallel/mesh.py" in probs[0]
+
+
+def test_spec_rule_homes_exempt_and_marker_honored():
+    src = textwrap.dedent("""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def kv_arena_sharding(mesh, heads):
+            return NamedSharding(mesh, P(None, None, None, "tensor", None))
+    """)
+    # the sharding-policy homes ARE the sanctioned spec constructors
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/parallel/sharding.py") == []
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/parallel/mesh.py") == []
+    # elsewhere, the marker opts a genuinely local spec out (shard_map
+    # in/out specs naming module-private axes)
+    assert lint.check_source(textwrap.dedent("""
+        from jax.sharding import PartitionSpec as P
+
+        def local_specs():
+            return P("rows")  # lint: allow-spec (shard_map-private axis)
+    """), filename="mmlspark_tpu/parallel/trainer.py") == []

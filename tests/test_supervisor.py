@@ -350,11 +350,48 @@ def test_process_spawner_requires_models():
         ProcessSpawner([])
 
 
+def test_process_spawner_device_pinning_disjoint_per_slot(tmp_path):
+    sp = ProcessSpawner(["m=mlp_tabular:{}"],
+                        events_dir=str(tmp_path / "ev"),
+                        devices_per_worker=2)
+    # slots are assigned at first sight and stable thereafter
+    assert sp.slot_of("w0") == 0
+    assert sp.slot_of("w1") == 1
+    assert sp.slot_of("w0") == 0
+    # slot i sees chips [i*K, (i+1)*K): disjoint visible-device sets
+    e0, e1 = sp.device_env("w0"), sp.device_env("w1")
+    assert e0["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert e1["TPU_VISIBLE_CHIPS"] == "2,3"
+    # exported in every runtime's spelling
+    for e in (e0, e1):
+        assert e["CUDA_VISIBLE_DEVICES"] == e["TPU_VISIBLE_CHIPS"]
+        assert e["HIP_VISIBLE_DEVICES"] == e["TPU_VISIBLE_CHIPS"]
+    # the pinning rides build_env into the child process
+    assert sp.build_env("w1")["TPU_VISIBLE_CHIPS"] == "2,3"
+
+
+def test_process_spawner_device_pinning_off_by_default(tmp_path):
+    sp = ProcessSpawner(["m=mlp_tabular:{}"],
+                        events_dir=str(tmp_path / "ev"))
+    assert sp.device_env("w0") == {}     # 0 = workers share the host
+    assert "TPU_VISIBLE_CHIPS" not in sp.build_env("w0")
+
+
+def test_process_spawner_explicit_env_outranks_pinning(tmp_path):
+    sp = ProcessSpawner(["m=mlp_tabular:{}"],
+                        events_dir=str(tmp_path / "ev"),
+                        devices_per_worker=1,
+                        env={"TPU_VISIBLE_CHIPS": "7"})
+    # operator-supplied env wins over the computed pinning
+    assert sp.build_env("w0")["TPU_VISIBLE_CHIPS"] == "7"
+
+
 # -- chaos: scenario registry + host scenario ---------------------------------
 
 def test_chaos_scenario_registry_covers_all_runners():
     from mmlspark_tpu.reliability import chaos
-    assert set(chaos.SCENARIOS) == {"train", "fleet", "decode", "host"}
+    assert set(chaos.SCENARIOS) == {"train", "fleet", "decode", "host",
+                                    "fleet_sharded", "decode_sharded"}
     assert all(desc for desc in chaos.SCENARIOS.values())
 
 
@@ -363,7 +400,8 @@ def test_cli_chaos_unknown_scenario_lists_registry(capsys):
     assert main(["chaos", "--scenario", "bogus"]) == 2
     err = capsys.readouterr().err
     assert "bogus" in err
-    for name in ("train", "fleet", "decode", "host"):
+    for name in ("train", "fleet", "decode", "host",
+                 "fleet_sharded", "decode_sharded"):
         assert name in err
 
 
