@@ -1539,6 +1539,111 @@ def config_serving_fleet() -> dict:
             "compile_ms": cold_box[1], "cold_start_ms": cold_box[0]}
 
 
+# -- config "serving_autopilot": SLO-driven fleet control under a spike ------
+
+def config_serving_autopilot() -> dict:
+    """Autopiloted fleet vs static fleet under the SAME seeded open-loop
+    spike + mid-spike replica kill — the chaos ``autopilot`` scenario's
+    drive reused verbatim, so bench and chaos measure one code path.
+    Every replica is a ``start=False`` server stepped once per 30 s
+    virtual round, so the whole lane is a pure function of its seed (no
+    wall-clock in the measured quantities).
+
+    The headline ``value`` is the shed-reduction ratio (static sheds /
+    autopiloted sheds — the capacity the scale lever actually bought),
+    gated higher-is-better like every lane headline. ``shed_rate`` and
+    ``spike_p99_ms`` (the autopiloted half's shed fraction and p99
+    request latency across the spike-window arrivals, in virtual ms)
+    are gated lower-is-better. ``decisions``/``suppressed``/
+    ``time_to_recover_s`` are informational: decision counts are
+    workload signatures, not regressions."""
+    import os
+    import random as _random
+    import tempfile
+
+    from mmlspark_tpu.control.autopilot import AutopilotPolicy
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.observability.metrics import nearest_rank
+    from mmlspark_tpu.reliability import chaos
+    from mmlspark_tpu.utils import config as mmlconfig
+
+    seed, replicas, rounds = 11, 3, 40
+    rng = _random.Random(seed ^ 0xA1707)
+    spike_start = rng.randint(6, 9)
+    spike_len = rng.randint(6, 9)
+    kill_round = spike_start + rng.randint(1, 3)
+    kill_idx = rng.randrange(replicas)
+    arrivals = [18 if spike_start <= r < spike_start + spike_len else 2
+                for r in range(rounds)]
+    total = sum(arrivals)
+
+    dim = 4
+    model = JaxModel(inputCol="x", outputCol="y", miniBatchSize=8)
+    model.set_model("mlp_tabular", input_dim=dim, hidden=[16],
+                    num_classes=3, seed=seed & 0xFFFF)
+    xrng = np.random.default_rng(seed)
+    stream = [xrng.normal(0, 1, (2, dim)).astype(np.float32)
+              for _ in range(total)]
+    policy = AutopilotPolicy(
+        tick_s=30.0, min_replicas=replicas, max_replicas=replicas + 3,
+        scale_up_queue=3.0, scale_down_queue=0.0, scale_cooldown_s=45.0,
+        shift_error_rate=0.5, shift_recover_rate=0.05, shift_step=0.5,
+        shift_cooldown_s=30.0, admission_factor=0.5,
+        admission_floor_frac=0.25, admission_relax_burn=1.0,
+        admission_cooldown_s=45.0, window_s=300.0,
+        max_actions_per_window=4)
+
+    with tempfile.TemporaryDirectory(prefix="bench_autopilot_") as tmp:
+        # shared on-disk compile cache: scaled-up replicas must LOAD
+        # their bucket programs, or steady_compiles would count setup
+        prior_cache = mmlconfig.get("runtime.compile_cache_dir")
+        mmlconfig.set("runtime.compile_cache_dir",
+                      os.path.join(tmp, "compile_cache"))
+        try:
+            static = chaos._autopilot_drive(
+                model, stream, arrivals, kill_round=kill_round,
+                kill_idx=kill_idx, replicas=replicas, policy=None)
+            auto = chaos._autopilot_drive(
+                model, stream, arrivals, kill_round=kill_round,
+                kill_idx=kill_idx, replicas=replicas, policy=policy,
+                events_path=os.path.join(tmp, "events.jsonl"))
+        finally:
+            mmlconfig.set("runtime.compile_cache_dir", prior_cache)
+
+    # spike-window arrivals are a contiguous index range (requests are
+    # numbered in arrival order)
+    lo = sum(arrivals[:spike_start])
+    hi = sum(arrivals[:spike_start + spike_len])
+
+    def spike_p99_ms(drive: dict) -> float:
+        lats = sorted(drive["latency_rounds"][i]
+                      for i in range(lo, hi)
+                      if i in drive["latency_rounds"])
+        return nearest_rank(lats, 99) * 30e3   # rounds -> virtual ms
+
+    acted = [d for d in auto["decisions"] if not d.get("suppressed")]
+    spike_end = spike_start + spike_len
+    recover = next((e["round"] for e in auto["trace"]
+                    if e["round"] >= spike_end
+                    and e["live"] == replicas), rounds)
+    shed_reduction = round(static["shed"] / max(1, auto["shed"]), 4)
+    return {"value": shed_reduction, "unit": "x shed reduction",
+            "vs_baseline": shed_reduction,   # the static fleet IS the baseline
+            "shed_rate": round(auto["shed"] / total, 4),
+            "static_shed_rate": round(static["shed"] / total, 4),
+            "spike_p99_ms": round(spike_p99_ms(auto), 1),
+            "static_spike_p99_ms": round(spike_p99_ms(static), 1),
+            "served": len(auto["scores"]), "shed": auto["shed"],
+            "static_shed": static["shed"],
+            "decisions": len(auto["decisions"]),
+            "actuated": len(acted),
+            "suppressed": len(auto["decisions"]) - len(acted),
+            "time_to_recover_s": (recover - spike_end) * 30.0,
+            "peak_replicas": max(e["replicas"] for e in auto["trace"]),
+            "steady_compiles": int(auto["final"]["compiles"]),
+            "replicas": replicas, "requests": total}
+
+
 # -- config "decode": generative lane (continuous batching over paged KV) ----
 
 def config_decode() -> dict:
@@ -2302,6 +2407,7 @@ CONFIGS = {
     "image_featurize": config_image_featurize,
     "serving": config_serving,
     "serving_fleet": config_serving_fleet,
+    "serving_autopilot": config_serving_autopilot,
     "decode": config_decode,
     "train_xl": config_train_xl,
     "decode_xl": config_decode_xl,
@@ -2315,6 +2421,7 @@ CONFIG_UNITS = {
     "longctx": "tokens/sec/chip",
     "serving": "requests/sec/chip",
     "serving_fleet": "requests/sec/chip",
+    "serving_autopilot": "x shed reduction",
     "decode": "tokens/sec/chip",
     "decode_sharedprefix": "tokens/sec/chip",
     "train_xl": "tokens/sec/chip",

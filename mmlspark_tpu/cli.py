@@ -385,6 +385,7 @@ def cmd_serve(args, passthrough) -> int:
     devmem.start_audit_poller()  # no-op unless observability.memory_poll_s
     fleet = None
     scraper = None
+    autopilot = None
     if args.replicas > 1:
         # fleet mode: N in-process replicas behind the health-checked
         # router (failover, fairness, rolling rollout; docs/SERVING.md)
@@ -397,7 +398,17 @@ def cmd_serve(args, passthrough) -> int:
         # (and the HBM ledger gauges) warm for `mmlspark-tpu top`
         scraper = FleetScraper(fleet)
         scraper.start()
+        if args.autopilot or bool(mmlconfig.get("autopilot.enabled")):
+            # the SLO-driven control loop over this fleet: traffic shift,
+            # replica scale, adaptive admission (docs/AUTOPILOT.md); its
+            # decisions land in the events sidecar as autopilot.* lines
+            from mmlspark_tpu.control.autopilot import Autopilot
+            autopilot = Autopilot(fleet)
+            autopilot.start()
         front = fleet.router
+    elif args.autopilot:
+        raise SystemExit("serve: --autopilot needs --replicas > 1 "
+                         "(the levers act on a fleet)")
     else:
         server = Server(models, **server_kwargs)
         front = server
@@ -437,6 +448,8 @@ def cmd_serve(args, passthrough) -> int:
         pass  # clean Ctrl-C shutdown path (no handler installed off-main)
     finally:
         httpd.server_close()
+        if autopilot is not None:
+            autopilot.stop()
         if scraper is not None:
             scraper.stop()
         if fleet is not None:
@@ -554,6 +567,9 @@ def cmd_chaos(args, passthrough) -> int:
     host``: SIGKILL a real worker PROCESS under fire; the supervisor
     warm-restarts it from the shared compile cache with zero failed
     requests, and a crash-looper ends breaker-open, not flapping.
+    ``--scenario autopilot``: the same seeded load spike + replica kill
+    against a static fleet and an autopiloted one — the autopilot must
+    shed strictly less, recover, and never flap (docs/AUTOPILOT.md).
     Writes ``chaos_verdict.json`` under --out; exit 0 iff every
     invariant held."""
     if args.scenario.endswith("_sharded") and "jax" not in sys.modules:
@@ -592,6 +608,9 @@ def cmd_chaos(args, passthrough) -> int:
         verdict = chaos.run_host_scenario(
             args.seed, outdir, replicas=args.replicas,
             requests=args.requests)
+    elif args.scenario == "autopilot":
+        verdict = chaos.run_autopilot_scenario(
+            args.seed, outdir, replicas=args.replicas)
     else:
         verdict = chaos.run_scenario(
             args.seed, outdir, total_steps=args.steps,
@@ -713,6 +732,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                          help="in-process serving replicas behind the "
                          "fleet router (failover, health probing, "
                          "rolling rollout; default 1 = plain server)")
+    serve_p.add_argument("--autopilot", action="store_true",
+                         help="run the SLO-driven autopilot over the "
+                         "fleet (traffic shift, replica scale, adaptive "
+                         "admission; needs --replicas > 1; "
+                         "docs/AUTOPILOT.md). Also on when "
+                         "autopilot.enabled is set")
     serve_p.add_argument("--events-dir", default="",
                          help="write this process's telemetry to "
                          "EVENTS_DIR/events-<pid>.jsonl (the per-pid "
@@ -766,7 +791,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "decode: kill a replica mid-generation, every "
                          "sequence completes via failover-restart; "
                          "host: SIGKILL a worker PROCESS under fire, "
-                         "warm restart from the shared compile cache "
+                         "warm restart from the shared compile cache; "
+                         "autopilot: seeded load spike + replica kill, "
+                         "static fleet vs autopiloted fleet "
                          "(default: train; unknown scenarios list the "
                          "registry and exit 2)")
     chaos_p.add_argument("--seed", type=int, default=0,

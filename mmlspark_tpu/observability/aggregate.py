@@ -264,6 +264,8 @@ class FleetScraper:
         self.replicas = reps
         self.clock = clock or events.wall
         self.timeout_s = float(timeout_s)
+        self._breaker_failures = breaker_failures
+        self._breaker_reset_s = breaker_reset_s
         self._breakers = {
             r.name: CircuitBreaker(f"scrape.{r.name}",
                                    failure_threshold=breaker_failures,
@@ -334,12 +336,31 @@ class FleetScraper:
                 "state": str(health.get("state", "")),
                 "stats": {}, "latency": None}
 
+    def _refresh_replicas(self) -> None:
+        """Re-read the router's handle set so replicas added/removed by
+        the autopilot's scale lever appear in the very next scrape (the
+        founding list used to be frozen at construction). Breakers are
+        created lazily for new names and kept for departed ones, so a
+        re-added name resumes its breaker history."""
+        if self.router is None:
+            return
+        reps = [h.replica for h in self.router._handles.values()]
+        self.replicas = reps
+        for r in reps:
+            if r.name not in self._breakers:
+                self._breakers[r.name] = CircuitBreaker(
+                    f"scrape.{r.name}",
+                    failure_threshold=self._breaker_failures,
+                    reset_timeout_s=self._breaker_reset_s,
+                    clock=self.clock)
+
     # -- the scrape --------------------------------------------------------
     def scrape(self) -> Dict[str, Any]:
         """One full pass over every replica -> merged snapshot. Never
         raises: per-replica failures are recorded in the snapshot (and
         fed to that replica's breaker)."""
         t0 = events.perf()
+        self._refresh_replicas()
         snap: Dict[str, Any] = {"ts": float(self.clock()), "replicas": {}}
         totals: Dict[str, float] = {}
         latencies: List[Dict[str, float]] = []

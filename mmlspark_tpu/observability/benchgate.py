@@ -9,7 +9,10 @@ one-line JSON result against the committed baseline per lane:
 - ``step_ms`` must not rise more than the tolerance above it;
 - ``mfu`` must not drop more than the tolerance below it;
 - ``ttft_p99_ms`` (serving lanes) must not rise more than the tolerance
-  above it.
+  above it;
+- ``shed_rate`` / ``spike_p99_ms`` (the autopilot lane) must not rise
+  more than the tolerance above it — a controller change that sheds
+  more or recovers slower under the seeded spike is a regression.
 
 A lane that was budget-skipped (or terminated) in EITHER run is marked
 ``skipped``, never red — congestion on the bench host must not fail CI.
@@ -107,6 +110,10 @@ def compare(fresh: Dict[str, Any], baseline: Dict[str, Any],
                    _num(base_lane, "mfu"), tolerance, True),
             _check("ttft_p99_ms", _num(fresh_lane, "ttft_p99_ms"),
                    _num(base_lane, "ttft_p99_ms"), tolerance, False),
+            _check("shed_rate", _num(fresh_lane, "shed_rate"),
+                   _num(base_lane, "shed_rate"), tolerance, False),
+            _check("spike_p99_ms", _num(fresh_lane, "spike_p99_ms"),
+                   _num(base_lane, "spike_p99_ms"), tolerance, False),
         ) if c is not None]
         # compile_ms / cold_start_ms are INFORMATIONAL: cold-start cost
         # swings with cache state and host load, so the comparison is
@@ -117,11 +124,17 @@ def compare(fresh: Dict[str, Any], baseline: Dict[str, Any],
         # HBM (shard_bytes_max) tracks the mesh topology, not the code
         # under test — reported so the crossing-the-chip win is a
         # visible number, never red.
+        # Decision counts and recovery time are controller workload
+        # signatures, not regressions — reported so a policy change that
+        # triples the action rate is visible, never red.
         for info_field, higher in (("compile_ms", False),
                                    ("cold_start_ms", False),
                                    ("prefix_hit_rate", True),
                                    ("spec_accept_rate", True),
-                                   ("shard_bytes_max", False)):
+                                   ("shard_bytes_max", False),
+                                   ("decisions", False),
+                                   ("suppressed", False),
+                                   ("time_to_recover_s", False)):
             c = _check(info_field, _num(fresh_lane, info_field),
                        _num(base_lane, info_field), tolerance, higher)
             if c is not None:

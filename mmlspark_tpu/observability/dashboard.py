@@ -53,10 +53,14 @@ class TopDashboard:
 
     def __init__(self, scraper: FleetScraper,
                  engine: Optional[SloEngine] = None, *,
+                 autopilot=None,
                  clock: Optional[Callable[[], float]] = None,
                  out=None, interval_s: float = 2.0):
         self.scraper = scraper
         self.engine = engine
+        # anything with an Autopilot-shaped stats() dict; the panel shows
+        # the live decision stream next to the signals that drive it
+        self.autopilot = autopilot
         self.clock = clock or events.wall
         self.out = out if out is not None else sys.stdout
         self.interval_s = float(interval_s)
@@ -131,6 +135,22 @@ class TopDashboard:
             lines.append(
                 f"slo      {st['objective']:<14} fast {st['burn_fast']:>7.2f}"
                 f"  slow {st['burn_slow']:>7.2f}  [{flag}]")
+
+        if self.autopilot is not None:
+            ap = self.autopilot.stats()
+            parts = [f"ticks {ap.get('ticks', 0)}",
+                     f"actions {ap.get('actions', 0)}",
+                     f"suppressed {ap.get('suppressed', 0)}"]
+            if ap.get("errors"):
+                parts.append(f"errors {ap['errors']}")
+            recent = [d for d in ap.get("recent", ())
+                      if not d.get("suppressed")][-3:]
+            if recent:
+                parts.append("last " + ", ".join(
+                    d["action"] + (f"({d['target']})" if d.get("target")
+                                   else "")
+                    for d in recent))
+            lines.append("autopilot " + "  ".join(parts))
 
         mem = snap.get("memory", {})
         kinds = mem.get("by_kind", {})

@@ -469,6 +469,35 @@ def build_report(path, top: int = 10,
         report["slo"] = {"events": len(slo_ev),
                          "objectives": dict(sorted(by_obj.items()))}
 
+    # -- autopilot (control-loop decisions: actuated + suppressed) ---------
+    ap_ev = [e for e in events if e.get("type") == "autopilot"]
+    if ap_ev:
+        acted = [e for e in ap_ev if not e.get("suppressed")]
+        held = [e for e in ap_ev if e.get("suppressed")]
+        by_action: Dict[str, int] = defaultdict(int)
+        for e in acted:
+            by_action[str(e.get("name", "?"))] += 1
+        reasons: Dict[str, int] = defaultdict(int)
+        for e in held:
+            token = str(e.get("reason", "?")).split()[0] if \
+                str(e.get("reason", "")).strip() else "?"
+            for prefix in ("cooldown", "window", "hold"):
+                if token.startswith(prefix):
+                    token = prefix
+                    break
+            reasons[token] += 1
+        report["autopilot"] = {
+            "decisions": len(ap_ev),
+            "actions": len(acted),
+            "suppressed": len(held),
+            "by_action": dict(sorted(by_action.items())),
+            "suppressed_reasons": dict(sorted(reasons.items())),
+            "last": [{"t": e.get("t"), "action": e.get("name", "?"),
+                      "target": str(e.get("target", "")),
+                      "reason": str(e.get("reason", ""))}
+                     for e in acted[-5:]],
+        }
+
     # -- HBM memory (memory.pressure / memory.audit events) ----------------
     mem_ev = [e for e in events if e.get("type") == "memory"]
     if mem_ev:
@@ -776,6 +805,23 @@ def render_report(path, top: int = 10) -> str:
                 f"  {name}: {o['burns']} burn(s), "
                 f"{o['breaches']} breach(es), {o['recovers']} recover(s); "
                 f"max fast burn {o['max_burn_fast']:.2f}x budget")
+        out.append("")
+
+    if "autopilot" in r:
+        ap = r["autopilot"]
+        out.append("autopilot:")
+        detail = ", ".join(f"{k}={v}" for k, v in ap["by_action"].items())
+        out.append(f"  decisions: {ap['decisions']} "
+                   f"({ap['actions']} actuated, "
+                   f"{ap['suppressed']} suppressed)"
+                   + (f"; actions: {detail}" if detail else ""))
+        if ap["suppressed_reasons"]:
+            detail = ", ".join(f"{k}={v}" for k, v in
+                               ap["suppressed_reasons"].items())
+            out.append(f"  suppressed: {detail}")
+        for d in ap.get("last", ()):
+            tgt = f" {d['target']}" if d["target"] else ""
+            out.append(f"  {d['action']}{tgt}: {d['reason']}")
         out.append("")
 
     if "memory" in r:

@@ -67,6 +67,10 @@ SCENARIOS: Dict[str, str] = {
     "decode_sharded": "the decode scenario with a mesh-sharded model + "
                       "head-sharded KV arena; failover token-identical "
                       "and the HBM ledger reconciles PER SHARD",
+    "autopilot": "seeded load spike + replica kill, twice: a static fleet "
+                 "vs the same fleet under the autopilot; the autopilot "
+                 "must shed strictly less, recover weights/replicas, and "
+                 "never flap (asserted from autopilot.* events alone)",
 }
 
 # the 2-D topology the *_sharded scenarios run on: tensor=2 model axis,
@@ -461,7 +465,7 @@ def run_fleet_scenario(seed: int, outdir: str, replicas: int = 3,
             if i % probe_every == 0:
                 probe_rounds.append(fleet.router.probe())
             if i == kill_at:
-                fleet.kill(kill_idx)
+                fleet.kill(kill_idx)  # lint: allow-actuate
             try:
                 results.append(np.asarray(
                     client_retry.call(fleet.submit, "chaos", x)))
@@ -711,7 +715,7 @@ def run_decode_scenario(seed: int, outdir: str, replicas: int = 2,
                             lane = rep.server._lanes.get("lm")
                             if (lane is not None
                                     and lane.steps > base[rep.name]):
-                                fleet.kill(j)
+                                fleet.kill(j)  # lint: allow-actuate
                                 killed_replica = rep.name
                                 break
                         _time.sleep(0.0005)
@@ -1053,7 +1057,8 @@ def run_host_scenario(seed: int, outdir: str, replicas: int = 2,
     router = None
     try:
         sup.start()
-        down = [n for n, s in sup.stats().items() if not s["running"]]
+        down = [n for n, s in sup.stats()["replicas"].items()
+                if not s["running"]]
         if down:
             raise ChaosError(f"workers failed to start: {down} "
                              f"(see {events_dir}/worker-*.log)")
@@ -1063,7 +1068,8 @@ def run_host_scenario(seed: int, outdir: str, replicas: int = 2,
         sup.start_monitor(0.05)
         for i, x in enumerate(stream):
             if i == kill_at:
-                killed_pid = sup.kill_replica(kill_name)
+                killed_pid = sup.kill_replica(  # lint: allow-actuate
+                    kill_name)
                 if killed_pid is None:
                     errors.append("kill landed on a slot with no live "
                                   "process")
@@ -1081,7 +1087,7 @@ def run_host_scenario(seed: int, outdir: str, replicas: int = 2,
         # child's cold-start — imports + cache loads — dominates)
         deadline = _time.monotonic() + 120
         while _time.monotonic() < deadline:
-            st = sup.stats()[kill_name]
+            st = sup.stats()["replicas"][kill_name]
             # ready_spawns (not spawns) is the gate: the respawned pid is
             # alive long before it binds, and only _on_ready guarantees
             # the replica's addr points at the NEW incarnation
@@ -1202,6 +1208,386 @@ def run_host_scenario(seed: int, outdir: str, replicas: int = 2,
         from mmlspark_tpu.observability import flightrec
         dumped = flightrec.dump(
             reason=f"chaos.host.red.seed{seed}",
+            path=os.path.join(outdir, "chaos_flightrec.jsonl"))
+        if dumped:
+            _LOG.error("chaos: flight recorder dumped to %s", dumped)
+    return verdict
+
+
+# -- autopilot scenario ------------------------------------------------------
+
+def _autopilot_drive(model, stream, arrivals, *, kill_round: int,
+                     kill_idx: int, replicas: int, policy,
+                     events_path: str = "") -> Dict[str, Any]:
+    """One fleet pass through the seeded spike schedule — the shared
+    driver behind both halves of the autopilot scenario (and the
+    ``serving_autopilot`` bench lane). ``policy=None`` is the static
+    fleet: same arrivals, same kill, no controller.
+
+    No executor threads: every replica is a ``start=False``
+    :class:`~mmlspark_tpu.serve.server.Server` stepped with
+    :meth:`~mmlspark_tpu.serve.server.Server.pump` (one coalesce+flush
+    per replica per round), and the autopilot/SLO stack runs on a
+    virtual clock advancing 30 s per round — the whole pass is a pure
+    function of the schedule, which is what lets the verdict compare
+    the two halves shed-for-shed."""
+    import numpy as np
+
+    from mmlspark_tpu.control.autopilot import Autopilot
+    from mmlspark_tpu.observability.aggregate import FleetScraper
+    from mmlspark_tpu.observability.slo import SloEngine
+    from mmlspark_tpu.serve.fleet import Fleet
+    from mmlspark_tpu.serve.server import ServerClosed, ServerOverloaded
+
+    fleet = Fleet({"chaos": model}, replicas=replicas, start=False,
+                  server_kwargs={"max_batch": 4, "queue_depth": 8})
+    vclock = {"t": 1000.0}
+    scraper = FleetScraper(fleet, clock=lambda: vclock["t"])
+    engine = SloEngine(clock=lambda: vclock["t"],
+                       fast_window_s=300.0, slow_window_s=900.0)
+    pilot = None
+    if policy is not None:
+        pilot = Autopilot(fleet, scraper=scraper, engine=engine,
+                          policy=policy, clock=lambda: vclock["t"])
+
+    prior_events = None
+    if events_path:
+        from mmlspark_tpu.utils import config as mmlconfig
+        prior_events = mmlconfig.get("observability.events_path")
+        mmlconfig.set("observability.events_path", events_path)
+
+    scores: Dict[int, Any] = {}
+    lat_rounds: Dict[int, int] = {}
+    shed = 0
+    hard_failed = 0
+    pending: List[tuple] = []   # (idx, replica, future, enqueue_round)
+    retries: List[int] = []
+    decisions: List[Dict[str, Any]] = []
+    trace: List[Dict[str, Any]] = []
+    next_req = 0
+
+    def enqueue(idx: int, rnd: int) -> None:
+        nonlocal shed
+        weights = {name: h.get("weight", 0.0) for name, h in
+                   fleet.router.stats()["replicas"].items()}
+        cands = [r for r in fleet.replicas
+                 if not r._dead and weights.get(r.name, 0.0) > 0.0]
+        if not cands:
+            shed += 1
+            return
+        # deterministic spread: shortest queue wins, name breaks ties
+        rep = min(cands, key=lambda r: (
+            r.server.stats().get("queue_depth", 0), r.name))
+        try:
+            fut = rep.server.submit_async("chaos", stream[idx])
+            pending.append((idx, rep, fut, rnd))
+        except (ServerOverloaded, ServerClosed):
+            shed += 1
+
+    def step_round(rnd: int, new_arrivals: int) -> None:
+        nonlocal pending, hard_failed, retries
+        if rnd == kill_round:
+            fleet.kill(kill_idx)  # lint: allow-actuate
+        this_round, retries = retries, []
+        nonlocal next_req
+        this_round += list(range(next_req, next_req + new_arrivals))
+        next_req += new_arrivals
+        for idx in this_round:
+            enqueue(idx, rnd)
+        for rep in list(fleet.replicas):
+            if not rep._dead:
+                try:
+                    rep.server.pump(max_batches=1)
+                except ServerClosed:  # pragma: no cover - kill race
+                    pass
+        still: List[tuple] = []
+        for idx, rep, fut, enq in pending:
+            if fut.done():
+                exc = fut.exception()
+                if exc is None:
+                    scores[idx] = np.asarray(fut.result())
+                    lat_rounds[idx] = rnd - enq
+                elif isinstance(exc, (ServerOverloaded, ServerClosed)):
+                    retries.append(idx)   # the kill shed it; try again
+                else:
+                    hard_failed += 1
+            elif rep._dead:
+                retries.append(idx)       # future died with the replica
+            else:
+                still.append((idx, rep, fut, enq))
+        pending = still
+        if pilot is not None:
+            decisions.extend(pilot.tick())
+        else:
+            engine.observe(scraper.slo_sample(scraper.scrape()))
+        status = engine.status()
+        trace.append({
+            "round": rnd, "t": vclock["t"],
+            "live": sum(1 for r in fleet.replicas
+                        if not r._dead and r.health().get("ready")),
+            "replicas": len(fleet.replicas),
+            "burning": any(s["burning"] for s in status),
+            "shed": shed})
+        vclock["t"] += 30.0
+
+    try:
+        for rnd, n in enumerate(arrivals):
+            step_round(rnd, n)
+        # drain rounds: no new arrivals, same tick cadence, until every
+        # admitted/retried request has resolved (bounded — base load is
+        # far below capacity, so a handful of rounds always suffices)
+        rnd = len(arrivals)
+        while (pending or retries) and rnd < len(arrivals) + 12:
+            step_round(rnd, 0)
+            rnd += 1
+
+        rstats = fleet.router.stats()["replicas"]
+        final = {
+            "live_ready": sum(1 for r in fleet.replicas
+                              if not r._dead and r.health().get("ready")),
+            "replicas": len(fleet.replicas),
+            "ready_weights": {r.name: rstats[r.name]["weight"]
+                              for r in fleet.replicas
+                              if not r._dead and r.name in rstats},
+            "dead_weights": {r.name: rstats[r.name]["weight"]
+                             for r in fleet.replicas
+                             if r._dead and r.name in rstats},
+            "capacity_rows": int(fleet.router.fairness.capacity_rows),
+            "baseline_rows": int(fleet.router.fairness.baseline_rows),
+            "compiles": sum(
+                int(s.get("registry.compiles", 0))
+                for s in fleet.stats()["servers"].values()),
+        }
+    finally:
+        if events_path:
+            from mmlspark_tpu.utils import config as mmlconfig
+            mmlconfig.set("observability.events_path", prior_events)
+            from mmlspark_tpu.observability import events as _events
+            _events.close()
+        fleet.close()
+
+    return {"scores": scores, "latency_rounds": lat_rounds,
+            "shed": shed, "hard_failed": hard_failed,
+            "unresolved": len(pending) + len(retries),
+            "decisions": decisions, "trace": trace, "final": final}
+
+
+def _no_flap(events_path: str, policy) -> Dict[str, Any]:
+    """The no-flap check, from the ``autopilot.*`` event stream ALONE
+    (not the in-memory decision list): no cooldown key may actuate two
+    DIFFERENT actions within one cooldown window — A -> B -> A inside a
+    window is the textbook control-loop flap the shared up/down cooldown
+    key exists to prevent."""
+    from mmlspark_tpu.control.autopilot import cooldown_key
+    cooldowns = {"shift": policy.shift_cooldown_s,
+                 "scale": policy.scale_cooldown_s,
+                 "admission": policy.admission_cooldown_s}
+    acted: List[Dict[str, Any]] = []
+    suppressed = 0
+    with open(events_path) as f:
+        for line in f:
+            e = json.loads(line)
+            if e.get("type") != "autopilot":
+                continue
+            if e.get("suppressed"):
+                suppressed += 1
+            else:
+                acted.append(e)
+    flaps: List[Dict[str, Any]] = []
+    last: Dict[str, tuple] = {}   # key -> (action, decision time)
+    for e in acted:
+        key = cooldown_key(e["lever"], e.get("target", ""))
+        cd = cooldowns.get(e["lever"], 0.0)
+        prev = last.get(key)
+        if prev and prev[0] != e["name"] and e["t"] - prev[1] < cd:
+            flaps.append({"key": key, "from": prev[0], "to": e["name"],
+                          "dt": e["t"] - prev[1], "cooldown_s": cd})
+        last[key] = (e["name"], e["t"])
+    return {"actuated_events": len(acted), "suppressed_events": suppressed,
+            "flaps": flaps}
+
+
+def run_autopilot_scenario(seed: int, outdir: str, replicas: int = 3,
+                           rounds: int = 40) -> Dict[str, Any]:
+    """Close the loop under fire: the same seeded open-loop load spike +
+    mid-spike replica kill hits a STATIC fleet and an AUTOPILOTED fleet,
+    and the verdict compares them.
+
+    The schedule (pure function of ``seed``): ~2 requests per 30 s
+    virtual round of base load, a spike of 18/round for a seeded span,
+    and one seeded replica killed without drain inside the spike.
+    Capacity is 2 requests per replica per round (``max_batch=4`` rows,
+    one pump each), so the spike overruns the static fleet by design.
+
+    Invariants (verdict JSON, ``outdir/chaos_verdict.json``):
+
+    - ``autopilot_sheds_fewer``  — the autopiloted half sheds STRICTLY
+      fewer requests than the identically-seeded static half (the
+      scale-up lever must actually buy capacity);
+    - ``scaled_up_under_spike``  — at least one ``scale_up`` actuated;
+    - ``replicas_recovered``     — after the spike the fleet is back to
+      exactly ``min_replicas`` ready replicas (scale-down unwound the
+      surge, the dead replica stayed dead);
+    - ``weights_recovered``      — every ready replica ends at weight
+      1.0 and the killed one at 0.0 (the shift lever ramped it out);
+    - ``admission_restored``     — the fairness quota is back at its
+      baseline (tighten was matched by relax);
+    - ``no_flap``                — from the ``autopilot.*`` EVENT STREAM
+      alone: no cooldown key actuates two different actions inside one
+      cooldown window;
+    - ``suppressed_decisions_visible`` — the event stream contains
+      considered-but-held decisions (cooldown/window/bounds), proving
+      suppression is observable, not silent;
+    - ``scores_bit_identical``   — every served score equals the
+      single-server reference, through the kill, the scale events and
+      the weight shifts;
+    - ``steady_compiles_zero``   — the autopiloted half (scale-ups
+      included) triggered zero model compiles;
+    - ``zero_hard_failures`` / ``all_requests_resolved`` — every request
+      either served or shed; nothing lost, nothing wedged.
+    """
+    import numpy as np
+
+    from mmlspark_tpu.control.autopilot import AutopilotPolicy
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.serve.server import Server
+
+    os.makedirs(outdir, exist_ok=True)
+    errors: List[str] = []
+    verdict: Dict[str, Any] = {"seed": seed, "scenario": "autopilot",
+                               "replicas": replicas, "rounds": rounds}
+
+    rng = random.Random(seed ^ 0xA1707)
+    spike_start = rng.randint(6, 9)
+    spike_len = rng.randint(6, 9)
+    kill_round = spike_start + rng.randint(1, 3)
+    kill_idx = rng.randrange(replicas)
+    base_rate, spike_rate = 2, 18
+    arrivals = [spike_rate
+                if spike_start <= r < spike_start + spike_len
+                else base_rate for r in range(rounds)]
+    total_requests = sum(arrivals)
+    verdict["schedule"] = {
+        "spike_start": spike_start, "spike_len": spike_len,
+        "spike_rate": spike_rate, "base_rate": base_rate,
+        "kill_round": kill_round, "kill_replica": f"r{kill_idx}",
+        "total_requests": total_requests}
+
+    model = JaxModel(inputCol="x", outputCol="y", miniBatchSize=8)
+    model.set_model("mlp_tabular", input_dim=_DIM, hidden=[16],
+                    num_classes=3, seed=seed & 0xFFFF)
+    xrng = np.random.default_rng(seed)
+    stream = [xrng.normal(0, 1, (2, _DIM)).astype(np.float32)
+              for _ in range(total_requests)]
+
+    # every fleet server (founding AND autopilot-scaled) must load its
+    # bucket programs from the shared on-disk cache the reference server
+    # populates — that is what makes steady_compiles_zero assertable
+    # through scale_up events
+    from mmlspark_tpu.utils import config as mmlconfig
+    prior_cache = mmlconfig.get("runtime.compile_cache_dir")
+    mmlconfig.set("runtime.compile_cache_dir",
+                  os.path.join(outdir, "compile_cache"))
+    try:
+        # ground truth: the full stream on one server, same model object
+        ref_server = Server({"chaos": model}, max_batch=4, queue_depth=32)
+        try:
+            reference = [np.asarray(
+                ref_server.submit("chaos", x, timeout=30))
+                for x in stream]
+        finally:
+            ref_server.close()
+
+        policy = AutopilotPolicy(
+            tick_s=30.0, min_replicas=replicas,
+            max_replicas=replicas + 3, scale_up_queue=3.0,
+            scale_down_queue=0.0, scale_cooldown_s=45.0,
+            shift_error_rate=0.5, shift_recover_rate=0.05,
+            shift_step=0.5, shift_cooldown_s=30.0, admission_factor=0.5,
+            admission_floor_frac=0.25, admission_relax_burn=1.0,
+            admission_cooldown_s=45.0, window_s=300.0,
+            max_actions_per_window=4)
+
+        static = _autopilot_drive(model, stream, arrivals,
+                                  kill_round=kill_round,
+                                  kill_idx=kill_idx,
+                                  replicas=replicas, policy=None)
+        events_path = os.path.join(outdir, "autopilot_events.jsonl")
+        if os.path.exists(events_path):
+            os.remove(events_path)
+        auto = _autopilot_drive(model, stream, arrivals,
+                                kill_round=kill_round, kill_idx=kill_idx,
+                                replicas=replicas, policy=policy,
+                                events_path=events_path)
+    finally:
+        mmlconfig.set("runtime.compile_cache_dir", prior_cache)
+
+    identical = all(
+        np.array_equal(auto["scores"][i], reference[i])
+        for i in auto["scores"])
+    flap = _no_flap(events_path, policy)
+    acted = [d for d in auto["decisions"] if not d.get("suppressed")]
+    by_action: Dict[str, int] = {}
+    for d in acted:
+        by_action[d["action"]] = by_action.get(d["action"], 0) + 1
+    fin = auto["final"]
+
+    # time-to-recover: first post-spike round with the surge unwound
+    spike_end = spike_start + spike_len
+    recover_round = next(
+        (e["round"] for e in auto["trace"]
+         if e["round"] >= spike_end and e["live"] == replicas),
+        rounds)
+    verdict["static"] = {"shed": static["shed"],
+                         "served": len(static["scores"]),
+                         "hard_failed": static["hard_failed"]}
+    verdict["autopilot"] = {
+        "shed": auto["shed"], "served": len(auto["scores"]),
+        "hard_failed": auto["hard_failed"],
+        "decisions": len(auto["decisions"]),
+        "actuated": len(acted), "by_action": by_action,
+        "suppressed": flap["suppressed_events"],
+        "events_path": events_path,
+        "time_to_recover_s": (recover_round - spike_end) * 30.0,
+        "final": fin}
+    verdict["flaps"] = flap["flaps"]
+
+    invariants = {
+        "autopilot_sheds_fewer": auto["shed"] < static["shed"],
+        "scaled_up_under_spike": by_action.get("scale_up", 0) >= 1,
+        "replicas_recovered": fin["live_ready"] == replicas,
+        "weights_recovered": (
+            fin["ready_weights"]
+            and all(w == 1.0 for w in fin["ready_weights"].values())
+            and all(w == 0.0 for w in fin["dead_weights"].values())),
+        "admission_restored":
+            fin["capacity_rows"] == fin["baseline_rows"],
+        "no_flap": not flap["flaps"],
+        "suppressed_decisions_visible": flap["suppressed_events"] >= 1,
+        "scores_bit_identical":
+            identical and len(auto["scores"]) > 0,
+        "steady_compiles_zero": fin["compiles"] == 0,
+        "zero_hard_failures": (auto["hard_failed"] == 0
+                               and static["hard_failed"] == 0),
+        "all_requests_resolved": (
+            auto["unresolved"] == 0 and static["unresolved"] == 0
+            and len(auto["scores"]) + auto["shed"] == total_requests),
+    }
+    verdict["invariants"] = invariants
+    verdict["errors"] = errors
+    verdict["passed"] = all(invariants.values())
+
+    path = os.path.join(outdir, VERDICT_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(verdict, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    _LOG.info("chaos autopilot verdict (%s): %s", path,
+              "PASS" if verdict["passed"] else "FAIL")
+    if not verdict["passed"]:
+        from mmlspark_tpu.observability import flightrec
+        dumped = flightrec.dump(
+            reason=f"chaos.autopilot.red.seed{seed}",
             path=os.path.join(outdir, "chaos_flightrec.jsonl"))
         if dumped:
             _LOG.error("chaos: flight recorder dumped to %s", dumped)

@@ -111,6 +111,18 @@ was supposed to split. Route through the sharding helpers
 ``shard_map`` in/out specs naming module-private axes) marks the line
 ``# lint: allow-spec``.
 
+Rule 15 — fleet actuator calls (``set_weight`` / ``kill_replica`` /
+``scale_up`` / ``scale_down`` / ``add_replica`` / ``remove_replica`` /
+``set_capacity`` / ``reset_breaker``, plus ``.kill()`` on a
+replica/fleet receiver) outside ``control/`` and the existing
+rollout/supervisor homes: every control action must stay attributable —
+an actuation from a random module is invisible to the autopilot's
+decision telemetry (``autopilot.*`` events), so a post-mortem can no
+longer explain why a weight moved or a replica died. Route actions
+through ``control.autopilot`` (or the fleet/supervisor machinery that
+owns them); deliberate out-of-band actuations (a chaos scenario's kill,
+an operator script) mark the line ``# lint: allow-actuate``.
+
 Shared core for ``tools/check_reliability.py`` (standalone CLI),
 ``mmlspark-tpu check`` (installed CLI), and the in-pytest gate
 (tests/test_reliability_lint.py) — same single source of truth pattern as
@@ -194,6 +206,15 @@ _ALLOW_SPEC = "# lint: allow-spec"
 # sharding policy: the rule table, the topology resolver)
 _SPEC_HOMES = ("parallel/sharding.py", "parallel/mesh.py")
 _SPEC_CTORS = ("PartitionSpec", "NamedSharding")
+_ALLOW_ACTUATE = "# lint: allow-actuate"
+# the modules allowed to move fleet levers: the decision loop itself,
+# and the serve/ machinery that OWNS each lever (router weights, fleet
+# scale/rollout, supervisor restart)
+_ACTUATE_HOMES = ("control/autopilot.py", "serve/router.py",
+                  "serve/fleet.py", "serve/supervisor.py")
+_ACTUATE_CALLS = ("set_weight", "kill_replica", "scale_up", "scale_down",
+                  "add_replica", "remove_replica", "set_capacity",
+                  "reset_breaker")
 
 
 def _is_raw_sync(call: ast.Call) -> bool:
@@ -329,6 +350,30 @@ def _is_spec_ctor(call: ast.Call) -> bool:
     return isinstance(f, ast.Attribute) and f.attr in _SPEC_CTORS
 
 
+def _is_actuator_call(call: ast.Call) -> bool:
+    """A fleet-lever actuation: any attribute call named in
+    :data:`_ACTUATE_CALLS` (the lever methods are distinctive enough
+    that the name alone is the signal), plus ``.kill(...)`` where the
+    receiver's terminal name mentions ``replica`` or ``fleet`` (the
+    chaos kill lever; ``proc.kill()``/``handle.kill()`` keep their own
+    Rule 12 contract)."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return False
+    if f.attr in _ACTUATE_CALLS:
+        return True
+    if f.attr != "kill":
+        return False
+    v = f.value
+    if isinstance(v, ast.Name):
+        name = v.id
+    elif isinstance(v, ast.Attribute):
+        name = v.attr
+    else:
+        return False
+    return "replica" in name.lower() or "fleet" in name.lower()
+
+
 def _is_signal_signal(call: ast.Call) -> bool:
     """``signal.signal(...)`` (or any ``<x>.signal(...)`` attribute call on
     a name ending in ``signal``) — the handler-installation form. A bare
@@ -361,6 +406,8 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     quant_scoped = "serve/" in norm and not norm.endswith(_QUANT_HOME)
     # Rule 14 scope: everywhere, the sharding-policy homes exempt
     spec_scoped = not any(norm.endswith(h) for h in _SPEC_HOMES)
+    # Rule 15 scope: everywhere, the decision loop + lever owners exempt
+    actuate_scoped = not any(norm.endswith(h) for h in _ACTUATE_HOMES)
 
     def _allowed(lineno: int) -> bool:
         # marker anywhere on the offending line opts that line out
@@ -402,6 +449,10 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     def _spec_allowed(lineno: int) -> bool:
         return (0 < lineno <= len(lines)
                 and _ALLOW_SPEC in lines[lineno - 1])
+
+    def _actuate_allowed(lineno: int) -> bool:
+        return (0 < lineno <= len(lines)
+                and _ALLOW_ACTUATE in lines[lineno - 1])
 
     for node in ast.walk(tree):
         if (isinstance(node, ast.Call)
@@ -523,6 +574,15 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
                 "without auditing every module; route through the "
                 "sharding helpers, or mark the line "
                 f"`{_ALLOW_SPEC}`)")
+        elif (isinstance(node, ast.Call) and actuate_scoped
+                and _is_actuator_call(node)
+                and not _actuate_allowed(node.lineno)):
+            problems.append(
+                f"{filename}:{node.lineno}: fleet actuator call outside "
+                f"control/ and {'/'.join(_ACTUATE_HOMES[1:])} (control "
+                "actions must stay attributable in the autopilot's "
+                "decision telemetry; route through control.autopilot, "
+                f"or mark the line `{_ALLOW_ACTUATE}`)")
         elif (isinstance(node, ast.Call) and _is_raw_sync(node)
                 and not sync_home
                 and not _sync_allowed(node.lineno)):

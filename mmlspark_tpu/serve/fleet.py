@@ -45,6 +45,15 @@ from mmlspark_tpu.utils.logging import get_logger
 logger = get_logger("serve.fleet")
 
 
+class RolloutAborted(RuntimeError):
+    """A rollout guard stopped the rollout after the canary took traffic
+    on the new version and the SLO started burning. The canary KEEPS the
+    new version (it is already warmed and back in rotation — yanking it
+    mid-burn would double the disruption); every replica after it still
+    serves the old one. The operator decides between re-running the
+    rollout and rolling the canary back."""
+
+
 class InProcessReplica:
     """One in-process :class:`Server` behind the Replica protocol.
 
@@ -156,6 +165,12 @@ class Fleet:
         self._sleep = sleep if sleep is not None else time.sleep
         skw = dict(server_kwargs or {})
         skw.setdefault("clock", clock)
+        # kept so scale_up() builds replicas identical to the founding
+        # set (same model OBJECTS -> shared jit caches, no new compiles)
+        self._models = models
+        self._server_kwargs = skw
+        self._start = start
+        self._next_idx = n
         self.servers = [Server(models, start=start, **skw)
                         for _ in range(n)]
         self.replicas = [InProcessReplica(f"r{i}", srv)
@@ -190,10 +205,58 @@ class Fleet:
         a chaos race is a no-op, not an error."""
         self.replicas[index].kill()
 
+    # -- scale actuators (lint Rule 15; the autopilot's lever) --------------
+    def scale_up(self) -> str:
+        """Add one replica over the SAME model objects as the founding
+        set — shared jit/program caches mean the new replica costs zero
+        new compiles (the ``steady_compiles == 0`` invariant holds
+        through scale events). It enters the router ready at weight 1.0;
+        returns the new replica's name."""
+        if self._closed:
+            raise RuntimeError("fleet is closed")
+        srv = Server(self._models, start=self._start,
+                     **self._server_kwargs)
+        name = f"r{self._next_idx}"
+        self._next_idx += 1
+        rep = InProcessReplica(name, srv)
+        self.servers.append(srv)
+        self.replicas.append(rep)
+        self.router.add_replica(rep)
+        if events.recording_enabled():
+            events.emit("fleet", "scale_up", replica=name,
+                        replicas=len(self.replicas))
+        return name
+
+    def scale_down(self, name: str,
+                   drain_timeout_s: Optional[float] = None) -> None:
+        """Retire one replica gracefully: out of the router rotation
+        first (no new traffic), then drain in-flight work, then close its
+        server. The inverse of :meth:`scale_up`; killing is what
+        :meth:`kill` is for."""
+        timeout = float(drain_timeout_s if drain_timeout_s is not None
+                        else mmlconfig.get("serving.drain_timeout_s"))
+        rep = next((r for r in self.replicas if r.name == name), None)
+        if rep is None:
+            raise KeyError(f"unknown replica {name!r}")
+        self.router.remove_replica(name)
+        if not rep._dead:
+            try:
+                self._wait_idle(rep.server, timeout)
+            finally:
+                rep.server.close(drain=True)
+        self.replicas.remove(rep)
+        if rep.server in self.servers:
+            self.servers.remove(rep.server)
+        if events.recording_enabled():
+            events.emit("fleet", "scale_down", replica=name,
+                        replicas=len(self.replicas))
+
     # -- rolling rollout ----------------------------------------------------
     def rollout(self, name: str, model, version: str,
                 warm_x=None,
-                drain_timeout_s: Optional[float] = None) -> Dict:
+                drain_timeout_s: Optional[float] = None,
+                guard: Optional[Callable[[str], Optional[str]]] = None,
+                ) -> Dict:
         """Roll ``name`` to ``model``@``version`` across the fleet with
         zero downtime: one replica at a time leaves rotation, drains,
         swaps, warms, and returns — the rest keep serving throughout.
@@ -203,14 +266,21 @@ class Fleet:
         AND AOT-compiling its bucket; without it the warm step only
         builds the apply (the first request pays bucket compilation).
         The first replica is the canary: its warm failure aborts the
-        rollout with every other replica still on the old version."""
+        rollout with every other replica still on the old version.
+
+        ``guard`` is the autopilot's rollout-abort hook: called with the
+        replica name AFTER each replica is back in rotation on the new
+        version; a non-empty return value (the reason, e.g. "canary SLO
+        burning") raises :class:`RolloutAborted` before the next replica
+        is touched. See :meth:`~mmlspark_tpu.control.autopilot.Autopilot.
+        rollout_guard`."""
         timeout = float(drain_timeout_s if drain_timeout_s is not None
                         else mmlconfig.get("serving.drain_timeout_s"))
         report: Dict = {"model": name, "version": version, "replicas": []}
         if events.recording_enabled():
             events.emit("rollout", "deploy", model=name, version=version,
                         replicas=len(self.replicas))
-        for rep in self.replicas:
+        for rep in list(self.replicas):  # scale events must not shift it
             if rep._dead:
                 report["replicas"].append(
                     {"replica": rep.name, "status": "skipped_dead"})
@@ -239,6 +309,17 @@ class Fleet:
                             version=version, replica=rep.name,
                             weight=weight)
             report["replicas"].append(step)
+            if guard is not None:
+                reason = guard(rep.name)
+                if reason:
+                    step["status"] = "aborted_after"
+                    if events.recording_enabled():
+                        events.emit("rollout", "abort", model=name,
+                                    version=version, replica=rep.name,
+                                    reason=str(reason))
+                    raise RolloutAborted(
+                        f"rollout of {name}@{version} aborted at "
+                        f"{rep.name}: {reason}")
         if events.recording_enabled():
             events.emit("rollout", "done", model=name, version=version,
                         updated=sum(1 for r in report["replicas"]

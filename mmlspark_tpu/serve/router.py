@@ -142,6 +142,9 @@ class WeightedFairAdmission:
             raise ValueError(
                 f"capacity_rows must be >= 1, got {capacity_rows}")
         self.capacity_rows = int(capacity_rows)
+        # the configured quota before any autopilot tightening: relax
+        # actions ramp capacity back toward this, never past it
+        self.baseline_rows = int(capacity_rows)
         self.weights = dict(weights or {})
         self.default_weight = float(
             default_weight if default_weight is not None
@@ -192,6 +195,22 @@ class WeightedFairAdmission:
         with self._lock:
             held = self._inflight.get(tenant, 0)
             self._inflight[tenant] = max(0, held - rows)
+
+    def set_capacity(self, capacity_rows: int) -> None:
+        """Adaptive-admission actuator (lint Rule 15): resize the fleet
+        quota all tenant shares are computed from. Tightening under burn
+        turns blind per-replica sheds into ordered per-tenant throttles;
+        relaxing ramps back toward :attr:`baseline_rows`. In-flight work
+        is untouched — only future admits see the new shares."""
+        cap = int(capacity_rows)
+        if cap < 1:
+            raise ValueError(f"capacity_rows must be >= 1, got {cap}")
+        with self._lock:
+            old = self.capacity_rows
+            self.capacity_rows = cap
+        if events.recording_enabled():
+            events.emit("fleet", "capacity", capacity_rows=cap,
+                        previous=old)
 
     def stats(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
@@ -251,6 +270,9 @@ class Router:
         self._sleep = sleep
         self._lock = threading.Lock()
         self._handles: "Dict[str, _Handle]" = {}
+        # kept so add_replica() builds breakers identical to these
+        self._breaker_failures = breaker_failures
+        self._breaker_reset_s = breaker_reset_s
         for r in replicas:
             if r.name in self._handles:
                 raise ValueError(f"duplicate replica name {r.name!r}")
@@ -294,6 +316,41 @@ class Router:
     # -- replica set -------------------------------------------------------
     def replica_names(self) -> List[str]:
         return sorted(self._handles)
+
+    def add_replica(self, replica, *, weight: float = 1.0) -> None:
+        """Scale-up actuator (lint Rule 15): put a new backend into
+        rotation with its own fresh breaker, same knobs as the founding
+        set. The next :meth:`_pick` can route to it immediately."""
+        if weight < 0:
+            raise ValueError(f"weight must be >= 0, got {weight}")
+        with self._lock:
+            if replica.name in self._handles:
+                raise ValueError(
+                    f"duplicate replica name {replica.name!r}")
+            breaker = CircuitBreaker(
+                f"fleet.{replica.name}",
+                failure_threshold=self._breaker_failures,
+                reset_timeout_s=self._breaker_reset_s, clock=self.clock)
+            h = _Handle(replica, breaker)
+            h.weight = float(weight)
+            self._handles[replica.name] = h
+        if events.recording_enabled():
+            events.emit("fleet", "add_replica", replica=replica.name,
+                        weight=weight)
+
+    def remove_replica(self, name: str) -> None:
+        """Scale-down actuator (lint Rule 15): take a backend out of the
+        rotation entirely. In-flight work on it is untouched — callers
+        drain the backend themselves (``Fleet.scale_down`` does)."""
+        with self._lock:
+            if name not in self._handles:
+                raise KeyError(f"unknown replica {name!r}")
+            if len(self._handles) == 1:
+                raise ValueError(
+                    "cannot remove the last replica from the router")
+            del self._handles[name]
+        if events.recording_enabled():
+            events.emit("fleet", "remove_replica", replica=name)
 
     def set_weight(self, name: str, weight: float) -> None:
         """Traffic share for one replica (0.0 = out of rotation — the

@@ -450,6 +450,43 @@ class Server:
                    for i in range(0, arr.shape[0], self.max_batch)]
         return np.concatenate([f.result(timeout) for f in futures], axis=0)
 
+    def pump(self, max_batches: Optional[int] = None) -> int:
+        """Synchronously run the executor's coalesce+flush step on the
+        CALLER's thread: drain every queued ticket into the batcher, then
+        flush up to ``max_batches`` groups (all of them when ``None``).
+        Returns the number of groups flushed.
+
+        This is the deterministic drive for ``start=False`` servers — the
+        autopilot chaos scenario and bench lane step whole fleets in
+        virtual time with it, one pump per replica per tick, so the
+        request schedule is a pure function of the seed. Unscored backlog
+        stays in the BOUNDED queue (only the rows each flushed group can
+        take are drained), so ``queue_depth`` remains an honest
+        backpressure signal between pumps — the signal the autopilot's
+        scale lever reads. Calling it on a started server is unsupported
+        (two executors would race for the same batcher)."""
+        if self._closed:
+            raise ServerClosed("server closed")
+        done = 0
+        while max_batches is None or done < max_batches:
+            rows = 0
+            while rows < self.max_batch:
+                try:
+                    t = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if t is _STOP:
+                    continue
+                self._batcher.offer(t)
+                rows += t.rows
+            if not len(self._batcher):
+                break
+            self._flush()
+            done += 1
+        if metrics.metrics_enabled():
+            metrics.gauge("serving.queue_depth").set(self._queue.qsize())
+        return done
+
     # -- executor ----------------------------------------------------------
     def _run(self) -> None:
         # liveness: the executor beats once per loop pass; the idle wait

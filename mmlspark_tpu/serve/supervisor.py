@@ -572,10 +572,14 @@ class Supervisor:
                     reason, len(live))
 
     def stats(self) -> Dict[str, object]:
-        out: Dict[str, object] = {}
+        """Per-replica lifecycle stats plus the fleet-level
+        ``desired_replicas`` vs ``live_replicas`` pair — the gap between
+        "what the supervisor is supposed to keep running" and "what is
+        actually up right now" that scale decisions are judged by."""
+        reps: Dict[str, object] = {}
         for st in self._states.values():
             h = st.handle
-            out[st.name] = {
+            reps[st.name] = {
                 "pid": getattr(h, "pid", None) if h is not None else None,
                 "running": h is not None and h.poll() is None,
                 "spawns": st.spawns,
@@ -584,7 +588,12 @@ class Supervisor:
                 "breaker": self.breakers[st.name].state,
                 "addr": st.replica.addr,
             }
-        return out
+        return {
+            "replicas": reps,
+            "desired_replicas": len(self._states),
+            "live_replicas": sum(1 for r in reps.values()
+                                 if r["running"]),
+        }
 
     def __enter__(self) -> "Supervisor":
         return self

@@ -173,6 +173,45 @@ _DEFAULTS: Dict[str, Any] = {
     "slo.slow_window_s": 3600.0,       # slow burn window (sustained burn)
     "slo.fast_burn": 14.4,             # burn-rate threshold, fast window
     "slo.slow_burn": 6.0,              # burn-rate threshold, slow window
+    # autopilot (control/autopilot.py — the SLO-driven control loop that
+    # actuates router weights, replica count, admission quotas, and
+    # rollout aborts from the scraper/SLO/ledger signals; every decision
+    # and every suppressed decision is an `autopilot.*` event; see
+    # docs/AUTOPILOT.md for the signal -> lever matrix and tuning runbook)
+    "autopilot.enabled": False,        # `serve --autopilot` flips this on
+    "autopilot.tick_s": 5.0,           # evaluation cadence (injectable
+                                       # clock; one decide() per tick)
+    "autopilot.min_replicas": 1,       # scale floor — also the repair
+                                       # target after a replica death
+    "autopilot.max_replicas": 8,       # scale ceiling (bounds veto)
+    "autopilot.hbm_limit_bytes": 0,    # >0 = veto scale-up when projected
+                                       # fleet HBM (ledger total + one
+                                       # replica's share) would exceed it
+    "autopilot.scale_up_queue": 4.0,   # mean queue depth per ready
+                                       # replica at/above which the fleet
+                                       # grows one replica
+    "autopilot.scale_down_queue": 0.0,  # mean queue depth at/below which
+                                        # an idle, non-burning fleet
+                                        # shrinks (hysteresis gap vs up)
+    "autopilot.scale_cooldown_s": 25.0,
+    "autopilot.shift_error_rate": 0.5,  # per-tick failure fraction
+                                        # at/above which traffic ramps
+                                        # OFF a replica (outlier shift)
+    "autopilot.shift_recover_rate": 0.05,  # fraction at/below which a
+                                           # ready replica's weight ramps
+                                           # back (separate up threshold)
+    "autopilot.shift_step": 0.5,       # router weight moved per action
+    "autopilot.shift_cooldown_s": 20.0,
+    "autopilot.admission_factor": 0.5,  # capacity_rows multiplier per
+                                        # tighten (relax divides by it)
+    "autopilot.admission_floor_frac": 0.25,  # tighten floor as a fraction
+                                             # of the baseline capacity
+    "autopilot.admission_relax_burn": 1.0,  # fast burn at/below which a
+                                            # tightened quota relaxes
+    "autopilot.admission_cooldown_s": 25.0,
+    "autopilot.window_s": 120.0,       # rolling actuation-budget window
+    "autopilot.max_actions_per_window": 8,  # hard budget: decisions past
+                                            # it are suppressed ("window")
 }
 
 _lock = threading.Lock()

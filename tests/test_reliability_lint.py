@@ -561,10 +561,17 @@ def test_process_rule_marker_and_non_os_receivers():
             p = subprocess.Popen(argv)  # lint: allow-process
             os.kill(pid, 9)  # lint: allow-process
             proc.kill()           # handle method, not os.kill
-            replica.kill()        # Fleet chaos lever, not a process op
+            replica.kill()  # lint: allow-actuate
             subprocess.run(argv)  # run() is not Popen
             return p
     """), filename="mmlspark_tpu/reliability/chaos.py") == []
+    # without the actuate marker, the same kill is still clean under the
+    # PROCESS rule (non-os receiver) — it is Rule 15 that takes over
+    probs = lint.check_source(textwrap.dedent("""
+        def chaos_lever(replica):
+            replica.kill()
+    """), filename="mmlspark_tpu/reliability/chaos.py")
+    assert len(probs) == 1 and "actuator" in probs[0]
 
 
 # -- Rule 13: quantization arithmetic stays inside kvcache.py -----------------

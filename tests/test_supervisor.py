@@ -130,7 +130,9 @@ def test_start_spawns_all_and_registers_addrs():
     sp = FakeSpawner()
     sup = make_sup(sp, ["a", "b"], clock)
     sup.start()
-    st = sup.stats()
+    full = sup.stats()
+    assert full["desired_replicas"] == 2 and full["live_replicas"] == 2
+    st = full["replicas"]
     assert st["a"]["running"] and st["b"]["running"]
     assert st["a"]["spawns"] == 1 and st["b"]["spawns"] == 1
     # the announce addr lands on the pre-built HttpReplica, normalized
@@ -160,19 +162,19 @@ def test_crash_backs_off_restarts_and_reregisters(tmp_path):
         # survive min_uptime -> incarnation confirmed, breaker success
         clock.advance(1.5)
         sup.poll_once()
-        assert sup.stats()["a"]["consecutive_crashes"] == 0
+        assert sup.stats()["replicas"]["a"]["consecutive_crashes"] == 0
 
         sp.handles["a"][0].die(3)
         sup.poll_once()
         # out of rotation immediately; restart scheduled at +base_delay
         assert router.weights["a"] == 0.0
-        assert sup.stats()["a"]["running"] is False
+        assert sup.stats()["replicas"]["a"]["running"] is False
         sup.poll_once()                     # before the backoff expires
-        assert sup.stats()["a"]["spawns"] == 1
+        assert sup.stats()["replicas"]["a"]["spawns"] == 1
 
         clock.advance(2.0)                  # base_delay
         sup.poll_once()
-        st = sup.stats()["a"]
+        st = sup.stats()["replicas"]["a"]
         assert st["running"] and st["spawns"] == 2
         # re-registered: weight restored, fleet breaker reset, new addr
         assert router.weights["a"] == 1.0
@@ -200,12 +202,12 @@ def test_confirmed_uptime_resets_consecutive_crashes():
         sup.poll_once()
         clock.advance(expected_delay)
         sup.poll_once()
-        assert sup.stats()["a"]["running"]
-    assert sup.stats()["a"]["consecutive_crashes"] == 2
+        assert sup.stats()["replicas"]["a"]["running"]
+    assert sup.stats()["replicas"]["a"]["consecutive_crashes"] == 2
     # surviving min_uptime clears the streak and the breaker
     clock.advance(1.5)
     sup.poll_once()
-    st = sup.stats()["a"]
+    st = sup.stats()["replicas"]["a"]
     assert st["consecutive_crashes"] == 0
     assert st["breaker"] == "closed"
     # the next crash starts the backoff ladder from the bottom again
@@ -213,10 +215,10 @@ def test_confirmed_uptime_resets_consecutive_crashes():
     sup.poll_once()
     clock.advance(1.9)
     sup.poll_once()
-    assert not sup.stats()["a"]["running"]   # 2.0 s not yet elapsed
+    assert not sup.stats()["replicas"]["a"]["running"]   # 2.0 s not yet elapsed
     clock.advance(0.1)
     sup.poll_once()
-    assert sup.stats()["a"]["running"]
+    assert sup.stats()["replicas"]["a"]["running"]
 
 
 def test_crash_loop_opens_breaker_no_flapping():
@@ -250,7 +252,7 @@ def test_crash_loop_opens_breaker_no_flapping():
     # exactly ONE half-open probe respawn, whose crash re-opened
     assert sp.count == 4
     assert sup.breaker_state("a") == "open"
-    assert sup.stats()["a"]["breaker"] == "open"
+    assert sup.stats()["replicas"]["a"]["breaker"] == "open"
 
 
 def test_shutdown_drains_children_and_stops_restarting():
@@ -314,7 +316,7 @@ def test_context_manager_shuts_down():
     sp = FakeSpawner()
     with make_sup(sp, ["a"], clock) as sup:
         sup.start()
-        assert sup.stats()["a"]["running"]
+        assert sup.stats()["replicas"]["a"]["running"]
     assert sp.handles["a"][0].terminated
 
 
@@ -391,7 +393,8 @@ def test_process_spawner_explicit_env_outranks_pinning(tmp_path):
 def test_chaos_scenario_registry_covers_all_runners():
     from mmlspark_tpu.reliability import chaos
     assert set(chaos.SCENARIOS) == {"train", "fleet", "decode", "host",
-                                    "fleet_sharded", "decode_sharded"}
+                                    "fleet_sharded", "decode_sharded",
+                                    "autopilot"}
     assert all(desc for desc in chaos.SCENARIOS.values())
 
 
