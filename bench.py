@@ -1644,6 +1644,123 @@ def config_serving_autopilot() -> dict:
             "replicas": replicas, "requests": total}
 
 
+def config_fleet_elastic() -> dict:
+    """Supervised process elasticity under steady traffic: a real
+    two-worker process fleet rides one full autopilot-driven scale cycle
+    — warm the shared compile cache, ``scale_up`` spawns a third
+    ``mmlspark-tpu serve`` process (announce -> ``/readyz`` -> router
+    registration), traffic keeps flowing, ``scale_down`` drains it back
+    out — and every request must score.
+
+    The headline ``value`` is the delivery ratio (served/offered, gated
+    higher-is-better: a change that drops requests while the fleet is
+    resizing turns the lane red). ``spawn_to_ready_ms`` (process
+    cold-start + cache loads, swings with host load) and
+    ``steady_compiles`` (the scaled-up worker's REAL compile count — the
+    warm-scale-up contract says 0) are informational in the benchgate;
+    ``rps`` is the wall-clock throughput through the whole cycle."""
+    import json as _json
+    import os
+    import tempfile
+    import time as _time
+    import urllib.request
+
+    from mmlspark_tpu.control.autopilot import Autopilot, AutopilotPolicy
+    from mmlspark_tpu.observability.aggregate import parse_prometheus_text
+    from mmlspark_tpu.reliability.retry import RetryPolicy
+    from mmlspark_tpu.serve.fleet import ProcessFleet
+    from mmlspark_tpu.serve.router import Router
+    from mmlspark_tpu.serve.supervisor import ProcessSpawner, Supervisor
+
+    seed, replicas, requests = 11, 2, 24
+    dim = 8
+    new_name = f"w{replicas}"
+    model_flag = "bench=mlp_tabular:" + _json.dumps(
+        {"input_dim": dim, "hidden": [16], "num_classes": 3,
+         "seed": seed})
+    xrng = np.random.default_rng(seed)
+    stream = [xrng.normal(0, 1, (2, dim)).astype(np.float32)
+              for _ in range(requests)]
+    client = RetryPolicy(max_attempts=6, base_delay=0.2, max_delay=2.0,
+                         jitter=0.0, name="bench.elastic", seed=seed)
+    served = 0
+    cache_hits = 0.0
+    steady_compiles = -1.0
+    router = None
+    with tempfile.TemporaryDirectory(prefix="bench_elastic_") as tmp:
+        spawner = ProcessSpawner(
+            [model_flag], events_dir=os.path.join(tmp, "events"),
+            compile_cache_dir=os.path.join(tmp, "compile_cache"),
+            extra_args=["--max-batch", "4", "--queue-depth", "32"])
+        sup = Supervisor(spawner, [f"w{i}" for i in range(replicas)],
+                         min_uptime_s=0.5, base_delay_s=0.05,
+                         max_delay_s=0.5)
+        t0 = _time.monotonic()
+        try:
+            sup.start()
+            router = Router(sup.replicas,
+                            failover_attempts=replicas + 2)
+            sup.attach_router(router)
+            router.probe()
+            sup.start_monitor(0.05)
+
+            def drive(chunk) -> int:
+                ok = 0
+                for x in chunk:
+                    y = np.asarray(client.call(router.submit, "bench", x))
+                    ok += int(y.shape[0] == 2)
+                return ok
+
+            third = requests // 3
+            served += drive(stream[:third])            # warm the cache
+            pilot_up = Autopilot(
+                ProcessFleet(sup, router),
+                policy=AutopilotPolicy(
+                    tick_s=1.0, min_replicas=replicas + 1,
+                    max_replicas=replicas + 2, scale_up_queue=1e6,
+                    scale_down_queue=0.0, scale_cooldown_s=0.0))
+            pilot_up.tick()                            # actuates add_slot
+            served += drive(stream[third:2 * third])   # wider fleet
+            rep = sup.replica(new_name)
+            with urllib.request.urlopen(f"{rep.addr}/metrics",
+                                        timeout=10) as resp:
+                parsed = parse_prometheus_text(resp.read().decode())
+            cache_hits = float(parsed.get(
+                "compile_cache_hits", {}).get("value", 0.0))
+            steady_compiles = float(parsed.get(
+                "compile_cache_misses", {}).get("value", 0.0))
+            pilot_down = Autopilot(
+                ProcessFleet(sup, router),
+                policy=AutopilotPolicy(
+                    tick_s=1.0, min_replicas=replicas,
+                    max_replicas=replicas + 2, scale_up_queue=1e6,
+                    scale_down_queue=0.0, scale_cooldown_s=0.0))
+            pilot_down.tick()                          # retires the slot
+            served += drive(stream[2 * third:])        # narrowed fleet
+            elapsed = _time.monotonic() - t0
+            sup_stats = sup.stats()
+        finally:
+            if router is not None:
+                router.close()
+            sup.shutdown(reason="bench fleet_elastic complete")
+
+    ready_hist = sup_stats.get("spawn_to_ready_ms", {})
+    return {"value": round(served / requests, 4),
+            "unit": "delivery ratio",
+            # perfect delivery IS the baseline: the ratio reads directly
+            # as "fraction of the static fleet's contract kept while
+            # elastic"
+            "vs_baseline": round(served / requests, 4),
+            "rps": round(requests / max(elapsed, 1e-9), 2),
+            "spawn_to_ready_ms": ready_hist.get("max", 0.0),
+            "spawn_to_ready_p50_ms": ready_hist.get("p50", 0.0),
+            "steady_compiles": int(steady_compiles),
+            "compile_cache_hits": int(cache_hits),
+            "final_replicas": sup_stats.get("desired_replicas"),
+            "replicas": replicas, "requests": requests,
+            "elapsed_s": round(elapsed, 2)}
+
+
 # -- config "decode": generative lane (continuous batching over paged KV) ----
 
 def config_decode() -> dict:
@@ -2408,6 +2525,7 @@ CONFIGS = {
     "serving": config_serving,
     "serving_fleet": config_serving_fleet,
     "serving_autopilot": config_serving_autopilot,
+    "fleet_elastic": config_fleet_elastic,
     "decode": config_decode,
     "train_xl": config_train_xl,
     "decode_xl": config_decode_xl,
@@ -2422,6 +2540,7 @@ CONFIG_UNITS = {
     "serving": "requests/sec/chip",
     "serving_fleet": "requests/sec/chip",
     "serving_autopilot": "x shed reduction",
+    "fleet_elastic": "delivery ratio",
     "decode": "tokens/sec/chip",
     "decode_sharedprefix": "tokens/sec/chip",
     "train_xl": "tokens/sec/chip",

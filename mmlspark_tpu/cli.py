@@ -470,7 +470,16 @@ def cmd_fleet(args, passthrough) -> int:
     (exponential backoff + per-replica circuit breaker) behind the
     health-checked HTTP router. SIGTERM drains every child before the
     front closes. Args after ``--`` are forwarded to each worker's
-    ``serve`` command line verbatim."""
+    ``serve`` command line verbatim.
+
+    ``--hosts h1,h2`` (or ``--hosts-file``) switches to the multi-host
+    launcher: one fleet (supervisor + workers) per host, each announced
+    front stitched behind ONE router/scraper control plane here, with
+    per-host ``supervisor.*`` event sidecars under
+    ``EVENTS_DIR/host-<host>/`` merging into one report. ``--autopilot``
+    (single-host mode) runs the SLO-driven control loop with the scale
+    lever actuating REAL worker processes through the supervisor
+    (``Supervisor.add_slot``/``retire_slot`` via ``ProcessFleet``)."""
     import threading
     from mmlspark_tpu.observability.aggregate import FleetScraper
     from mmlspark_tpu.reliability import preemption
@@ -488,6 +497,23 @@ def cmd_fleet(args, passthrough) -> int:
         else int(mmlconfig.get("fleet.replicas"))
     if replicas < 1:
         raise SystemExit(f"fleet: --replicas must be >= 1, got {replicas}")
+    hosts_spec = args.hosts or str(mmlconfig.get("fleet.hosts"))
+    if args.hosts_file:
+        from mmlspark_tpu.serve.launcher import read_hosts_file
+        if hosts_spec:
+            raise SystemExit("fleet: --hosts and --hosts-file are "
+                             "mutually exclusive")
+        hosts = read_hosts_file(args.hosts_file)
+    else:
+        from mmlspark_tpu.serve.launcher import parse_hosts
+        hosts = parse_hosts(hosts_spec)
+    if hosts:
+        if args.autopilot:
+            raise SystemExit(
+                "fleet: --autopilot is single-host for now (each host's "
+                "fleet supervises its own workers; run the autopilot "
+                "per host)")
+        return _fleet_multi_host(args, passthrough, hosts, replicas)
     events_dir = args.events_dir or os.path.join(os.getcwd(), "fleet-events")
     os.makedirs(events_dir, exist_ok=True)
     # the supervisor writes its OWN per-pid sidecar next to the workers'
@@ -509,6 +535,7 @@ def cmd_fleet(args, passthrough) -> int:
     sup = Supervisor(spawner, [f"w{i}" for i in range(replicas)])
     scraper = None
     httpd = None
+    autopilot = None
     try:
         sup.start()
         router = Router(sup.replicas)
@@ -520,6 +547,21 @@ def cmd_fleet(args, passthrough) -> int:
         scraper = FleetScraper(router)
         scraper.start()
         sup.start_monitor()
+        if args.autopilot or bool(mmlconfig.get("autopilot.enabled")):
+            backend = str(mmlconfig.get("autopilot.scale_backend"))
+            if backend == "inprocess":
+                raise SystemExit(
+                    "fleet: --autopilot over worker processes needs "
+                    "autopilot.scale_backend=process (or auto), got "
+                    f"{backend!r}")
+            # the scale lever actuates REAL processes: scale_up spawns a
+            # supervised worker (warm via the shared compile cache),
+            # scale_down drains + retires one (docs/AUTOPILOT.md)
+            from mmlspark_tpu.control.autopilot import Autopilot
+            from mmlspark_tpu.serve.fleet import ProcessFleet
+            autopilot = Autopilot(ProcessFleet(sup, router),
+                                  scraper=scraper)
+            autopilot.start()
         httpd, addr = serve_http(router, host=args.host, port=args.port)
         h = router.health()
         print(json.dumps({"serving": addr,             # lint: allow-print
@@ -548,9 +590,122 @@ def cmd_fleet(args, passthrough) -> int:
     finally:
         if httpd is not None:
             httpd.server_close()
+        if autopilot is not None:
+            autopilot.stop()
         if scraper is not None:
             scraper.stop()
         sup.shutdown()
+    return 0
+
+
+def _fleet_multi_host(args, passthrough, hosts, replicas) -> int:
+    """The ``fleet --hosts`` control plane: one fleet process per host
+    via :class:`~mmlspark_tpu.serve.launcher.HostLauncher`, every
+    announced host front behind one router + scraper here, SIGTERM
+    fanning the drain out to every host."""
+    import threading
+    from mmlspark_tpu.observability.aggregate import FleetScraper
+    from mmlspark_tpu.reliability import preemption
+    from mmlspark_tpu.serve.http import serve_http
+    from mmlspark_tpu.serve.launcher import HostLauncher
+    from mmlspark_tpu.serve.router import Router
+    from mmlspark_tpu.utils import config as mmlconfig
+    events_dir = args.events_dir or os.path.join(os.getcwd(), "fleet-events")
+    os.makedirs(events_dir, exist_ok=True)
+    # the control plane's own sidecar (launcher.* events) sits next to
+    # the per-host subdirectories; merge everything with
+    #   mmlspark-tpu report --glob 'EVENTS_DIR/**/events-*.jsonl'
+    mmlconfig.set("observability.events_path",
+                  os.path.join(events_dir, f"events-{os.getpid()}.jsonl"))
+    extra = list(passthrough)
+    if args.compile_cache_dir:
+        extra = ["--compile-cache-dir", args.compile_cache_dir] + extra
+    if args.devices_per_worker is not None:
+        extra = ["--devices-per-worker",
+                 str(args.devices_per_worker)] + extra
+    launcher = HostLauncher(hosts, args.model,
+                            replicas_per_host=replicas,
+                            events_dir=events_dir, extra_args=extra)
+    scraper = None
+    httpd = None
+    try:
+        launcher.launch()
+        router = Router(launcher.replicas())
+        router.probe()
+        router.start_prober()
+        scraper = FleetScraper(router)
+        scraper.start()
+        httpd, addr = serve_http(router, host=args.host, port=args.port)
+        h = router.health()
+        print(json.dumps({"serving": addr,             # lint: allow-print
+                          "hosts": launcher.stats(),
+                          "replicas_per_host": replicas,
+                          "pid": os.getpid(),
+                          "events_dir": events_dir,
+                          "live": h["live"], "ready": h["ready"]},
+                         default=str), flush=True)
+        preemption.install_handlers()
+
+        def monitor():
+            preemption.get_signal().wait()
+            launcher.shutdown()
+            httpd.shutdown()
+
+        mon = threading.Thread(target=monitor, daemon=True,
+                               name="mmlspark-tpu-hosts-drain")
+        mon.start()
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass  # clean Ctrl-C shutdown path
+    finally:
+        if httpd is not None:
+            httpd.server_close()
+        if scraper is not None:
+            scraper.stop()
+        launcher.shutdown()
+    return 0
+
+
+def cmd_autopilot(args, passthrough) -> int:
+    """Autopilot offline tooling. ``replay``: re-run the pure decision
+    core over recorded ``autopilot_signals`` telemetry under the
+    recorded policy (fidelity must be byte-identical) and any number of
+    candidate threshold overrides, ranked by counterfactual shed / SLO
+    burn / action count (docs/AUTOPILOT.md "Replay runbook")."""
+    from mmlspark_tpu.control import replay as rp
+    if args.subcommand != "replay":  # pragma: no cover - argparse gates
+        raise SystemExit(f"autopilot: unknown subcommand "
+                         f"{args.subcommand!r}")
+    log = rp.load_log(args.events)
+    if not log["ticks"]:
+        raise SystemExit(
+            "autopilot replay: no autopilot_signals/tick events in the "
+            "given log(s) — record a run with observability.events_path "
+            "set and the autopilot on")
+    recorded = rp.policy_from_fields(log["policy"] or {})
+    fidelity = rp.fidelity_check(
+        log["decisions"], rp.replay_decisions(log["ticks"], recorded))
+    candidates = {"recorded": recorded}
+    for spec in args.candidate:
+        label, sep, rest = spec.partition(":")
+        if not sep or not label:
+            raise SystemExit(
+                f"--candidate: expected LABEL:key=val[,key=val...], "
+                f"got {spec!r}")
+        try:
+            candidates[label] = rp.policy_from_fields(
+                log["policy"] or {}, rp.parse_overrides(rest))
+        except ValueError as e:
+            raise SystemExit(f"--candidate {label}: {e}")
+    ranked = rp.rank_policies(log["ticks"], candidates)
+    if args.json:
+        print(json.dumps({"fidelity": fidelity,    # lint: allow-print
+                          "ranking": ranked}, sort_keys=True))
+    else:
+        print(rp.format_ranking(ranked, fidelity))  # lint: allow-print
+    if log["policy"] is not None and not fidelity["identical"]:
+        return 1  # the replay-sufficiency contract broke: make it loud
     return 0
 
 
@@ -570,6 +725,11 @@ def cmd_chaos(args, passthrough) -> int:
     ``--scenario autopilot``: the same seeded load spike + replica kill
     against a static fleet and an autopiloted one — the autopilot must
     shed strictly less, recover, and never flap (docs/AUTOPILOT.md).
+    ``--scenario elastic``: SIGKILL a worker process MID
+    autopilot-driven supervised scale-up; zero failed requests, the
+    half-spawned slot completes registration or is cleanly reaped (no
+    zombie in the router rotation), desired == live after
+    reconciliation, and the warm scale-up pays zero XLA compiles.
     Writes ``chaos_verdict.json`` under --out; exit 0 iff every
     invariant held."""
     if args.scenario.endswith("_sharded") and "jax" not in sys.modules:
@@ -611,6 +771,10 @@ def cmd_chaos(args, passthrough) -> int:
     elif args.scenario == "autopilot":
         verdict = chaos.run_autopilot_scenario(
             args.seed, outdir, replicas=args.replicas)
+    elif args.scenario == "elastic":
+        verdict = chaos.run_elastic_scenario(
+            args.seed, outdir, replicas=args.replicas,
+            requests=args.requests)
     else:
         verdict = chaos.run_scenario(
             args.seed, outdir, total_steps=args.steps,
@@ -778,7 +942,47 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "visible-devices env); 0 = no pinning, workers "
                          "share (default: fleet.devices_per_worker "
                          "config)")
+    fleet_p.add_argument("--hosts", default="",
+                         help="comma list of hosts to fan one fleet out "
+                         "to each ('local' runs on this machine, other "
+                         "names go over ssh); the announced host fronts "
+                         "are stitched behind one router here (default: "
+                         "fleet.hosts config; empty = single host)")
+    fleet_p.add_argument("--hosts-file", default="",
+                         help="file with one host per line (# comments); "
+                         "mutually exclusive with --hosts")
+    fleet_p.add_argument("--autopilot", action="store_true",
+                         help="run the SLO-driven autopilot with the "
+                         "scale lever actuating real worker processes "
+                         "(Supervisor.add_slot/retire_slot; single-host "
+                         "mode only; also on when autopilot.enabled is "
+                         "set — see autopilot.scale_backend)")
     fleet_p.set_defaults(fn=cmd_fleet)
+
+    autopilot_p = sub.add_parser(
+        "autopilot",
+        help="autopilot offline tooling (counterfactual policy replay "
+             "over recorded decision telemetry)")
+    ap_sub = autopilot_p.add_subparsers(dest="subcommand", required=True)
+    replay_p = ap_sub.add_parser(
+        "replay",
+        help="re-run the pure decide() core over recorded "
+             "autopilot_signals events; verify byte-identical fidelity "
+             "under the recorded policy and rank candidate threshold "
+             "overrides by counterfactual shed/SLO/action outcome")
+    replay_p.add_argument("events", nargs="+",
+                          help="event JSONL path(s) from a recorded "
+                          "autopilot run (per-pid/per-host sidecars "
+                          "merge)")
+    replay_p.add_argument("--candidate", action="append", default=[],
+                          metavar="LABEL:KEY=VAL[,KEY=VAL...]",
+                          help="candidate policy: recorded thresholds "
+                          "with these overrides (repeatable), e.g. "
+                          "eager:scale_up_queue=2,scale_cooldown_s=10")
+    replay_p.add_argument("--json", action="store_true",
+                          help="emit fidelity + ranking as one JSON "
+                          "object instead of the table")
+    replay_p.set_defaults(fn=cmd_autopilot)
 
     chaos_p = sub.add_parser(
         "chaos",
@@ -793,7 +997,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "host: SIGKILL a worker PROCESS under fire, "
                          "warm restart from the shared compile cache; "
                          "autopilot: seeded load spike + replica kill, "
-                         "static fleet vs autopiloted fleet "
+                         "static fleet vs autopiloted fleet; "
+                         "elastic: SIGKILL a worker mid autopilot-driven "
+                         "supervised scale-up — no zombie slot, desired "
+                         "== live after reconciliation "
                          "(default: train; unknown scenarios list the "
                          "registry and exit 2)")
     chaos_p.add_argument("--seed", type=int, default=0,
@@ -810,8 +1017,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                          help="serve-phase request count (default 12)")
     chaos_p.add_argument("--replicas", type=int, default=3,
                          help="fleet width for --scenario fleet/decode; "
-                         "worker-process count for --scenario host "
-                         "(default 3)")
+                         "worker-process count for --scenario "
+                         "host/elastic (default 3)")
     chaos_p.set_defaults(fn=cmd_chaos)
 
     report_p = sub.add_parser(

@@ -373,8 +373,10 @@ class Autopilot:
     """The closed loop: scrape -> SLO observe -> :func:`decide` ->
     actuate + emit, once per tick.
 
-    ``fleet`` is an in-process :class:`~mmlspark_tpu.serve.fleet.Fleet`;
-    scraper/engine/policy/clock are injectable (the chaos scenario and
+    ``fleet`` is an in-process :class:`~mmlspark_tpu.serve.fleet.Fleet`
+    or a process-backed :class:`~mmlspark_tpu.serve.fleet.ProcessFleet`
+    (selected by ``autopilot.scale_backend`` in the CLI — same actuator
+    surface, real OS workers); scraper/engine/policy/clock are injectable (the chaos scenario and
     tests drive :meth:`tick` on a virtual clock; ``serve --autopilot``
     uses :meth:`start`'s daemon thread). Every decision is emitted as an
     ``autopilot`` event whether actuated or suppressed; actuation
@@ -402,6 +404,26 @@ class Autopilot:
         self._recent: Deque[Dict[str, Any]] = deque(maxlen=8)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._policy_emitted = False
+
+    def _emit_signals(self, sig: Dict[str, Any]) -> None:
+        """Record the replay feed: one ``autopilot_signals``/``policy``
+        event per run (the thresholds the recorded decisions were made
+        under) and one ``autopilot_signals``/``tick`` event per tick
+        (the FULL signal payload :func:`decide` saw). A distinct event
+        type from ``autopilot`` on purpose — decision consumers (the
+        chaos no-flap check, the report's decision counts) must not see
+        signal frames. Together they make ``mmlspark-tpu autopilot
+        replay`` exact: decide() is pure, so policy + signals reproduce
+        the decision list byte for byte."""
+        if not events.recording_enabled():
+            return
+        if not self._policy_emitted:
+            self._policy_emitted = True
+            events.emit("autopilot_signals", "policy",
+                        **{f.name: getattr(self.policy, f.name)
+                           for f in _dc_fields(AutopilotPolicy)})
+        events.emit("autopilot_signals", "tick", signals=sig)
 
     # -- one evaluation tick ---------------------------------------------
     def tick(self) -> List[Dict[str, Any]]:
@@ -417,6 +439,7 @@ class Autopilot:
                        "baseline_rows": int(getattr(
                            fairness, "baseline_rows",
                            fairness.capacity_rows))})
+        self._emit_signals(sig)
         decisions = decide(sig, self.policy, self.state)
         for d in decisions:
             if not d["suppressed"]:

@@ -127,6 +127,9 @@ def compare(fresh: Dict[str, Any], baseline: Dict[str, Any],
         # Decision counts and recovery time are controller workload
         # signatures, not regressions — reported so a policy change that
         # triples the action rate is visible, never red.
+        # spawn_to_ready_ms (process cold-start + cache loads) swings
+        # with host load, and steady_compiles is a warm-scale-up
+        # contract count — both reported, never red.
         for info_field, higher in (("compile_ms", False),
                                    ("cold_start_ms", False),
                                    ("prefix_hit_rate", True),
@@ -134,7 +137,9 @@ def compare(fresh: Dict[str, Any], baseline: Dict[str, Any],
                                    ("shard_bytes_max", False),
                                    ("decisions", False),
                                    ("suppressed", False),
-                                   ("time_to_recover_s", False)):
+                                   ("time_to_recover_s", False),
+                                   ("spawn_to_ready_ms", False),
+                                   ("steady_compiles", False)):
             c = _check(info_field, _num(fresh_lane, info_field),
                        _num(base_lane, info_field), tolerance, higher)
             if c is not None:

@@ -53,7 +53,7 @@ class TopDashboard:
 
     def __init__(self, scraper: FleetScraper,
                  engine: Optional[SloEngine] = None, *,
-                 autopilot=None,
+                 autopilot=None, supervisor=None,
                  clock: Optional[Callable[[], float]] = None,
                  out=None, interval_s: float = 2.0):
         self.scraper = scraper
@@ -61,6 +61,9 @@ class TopDashboard:
         # anything with an Autopilot-shaped stats() dict; the panel shows
         # the live decision stream next to the signals that drive it
         self.autopilot = autopilot
+        # anything with a Supervisor-shaped stats() dict; the panel shows
+        # desired vs live plus the elasticity in flight
+        self.supervisor = supervisor
         self.clock = clock or events.wall
         self.out = out if out is not None else sys.stdout
         self.interval_s = float(interval_s)
@@ -151,6 +154,22 @@ class TopDashboard:
                                    else "")
                     for d in recent))
             lines.append("autopilot " + "  ".join(parts))
+
+        if self.supervisor is not None:
+            sp = self.supervisor.stats()
+            desired = sp.get("desired_replicas", 0)
+            live = sp.get("live_replicas", 0)
+            parts = [f"desired {desired}",
+                     f"live {live}" + ("" if live == desired else " (!)")]
+            if sp.get("spawns_in_flight"):
+                parts.append(f"spawning {sp['spawns_in_flight']}")
+            if sp.get("retiring"):
+                parts.append(f"retiring {sp['retiring']}")
+            h = sp.get("spawn_to_ready_ms", {})
+            if h.get("count"):
+                parts.append(f"spawn->ready p50 {h['p50']:.0f}ms "
+                             f"p99 {h['p99']:.0f}ms")
+            lines.append("workers  " + "  ".join(parts))
 
         mem = snap.get("memory", {})
         kinds = mem.get("by_kind", {})

@@ -441,6 +441,32 @@ def build_report(path, top: int = 10,
         if restarts:
             sup["restart_ready_s_max"] = max(
                 float(e.get("ready_s", 0.0)) for e in restarts)
+        # elasticity: scale decisions (add_slot/retire) and the
+        # spawn->ready latency distribution from the ready events
+        adds = [e for e in sup_ev if e.get("name") == "add_slot"]
+        retires = [e for e in sup_ev if e.get("name") == "retire"]
+        noops = [e for e in sup_ev if e.get("name") == "retire_noop"]
+        if adds or retires or noops:
+            desired = [int(e["desired"]) for e in adds + retires
+                       if e.get("desired") is not None]
+            sup["elastic"] = {
+                "slots_added": len(adds),
+                "slots_retired": len(retires),
+                "retire_noops": len(noops),
+                "drained": sum(1 for e in retires if e.get("drained")),
+                "desired_final": desired[-1] if desired else None,
+            }
+        ready_ms = sorted(
+            float(e["spawn_to_ready_ms"]) for e in sup_ev
+            if e.get("name") == "ready"
+            and e.get("spawn_to_ready_ms") is not None)
+        if ready_ms:
+            sup["spawn_to_ready_ms"] = {
+                "count": len(ready_ms),
+                "p50": round(_pct(ready_ms, 50), 3),
+                "p99": round(_pct(ready_ms, 99), 3),
+                "max": round(ready_ms[-1], 3),
+            }
         shut = [e for e in sup_ev if e.get("name") == "shutdown"]
         if shut:
             sup["shutdowns"] = [
@@ -793,6 +819,22 @@ def render_report(path, top: int = 10) -> str:
         if "restart_ready_s_max" in sup:
             out.append(f"  slowest restart to ready: "
                        f"{sup['restart_ready_s_max']:.2f}s")
+        if "elastic" in sup:
+            el = sup["elastic"]
+            line = (f"  elastic: {el['slots_added']} slot(s) added, "
+                    f"{el['slots_retired']} retired "
+                    f"({el['drained']} drained cleanly)")
+            if el["retire_noops"]:
+                line += f", {el['retire_noops']} retire no-op(s)"
+            if el["desired_final"] is not None:
+                line += f"; desired now {el['desired_final']}"
+            out.append(line)
+        if "spawn_to_ready_ms" in sup:
+            h = sup["spawn_to_ready_ms"]
+            out.append(
+                f"  spawn->ready: p50 {h['p50']:.0f}ms, "
+                f"p99 {h['p99']:.0f}ms, max {h['max']:.0f}ms "
+                f"over {h['count']} spawn(s)")
         for s in sup.get("shutdowns", ()):
             out.append(f"  shutdown ({s['reason']}): "
                        f"{s['workers']} worker(s) drained")

@@ -71,6 +71,11 @@ SCENARIOS: Dict[str, str] = {
                  "vs the same fleet under the autopilot; the autopilot "
                  "must shed strictly less, recover weights/replicas, and "
                  "never flap (asserted from autopilot.* events alone)",
+    "elastic": "SIGKILL a worker mid autopilot-driven PROCESS scale-up; "
+               "zero failed requests, the half-spawned slot completes or "
+               "is reaped (never a zombie), the new worker comes up warm "
+               "with zero compiles, and both pilots' event logs replay "
+               "byte-identical",
 }
 
 # the 2-D topology the *_sharded scenarios run on: tensor=2 model axis,
@@ -1588,6 +1593,384 @@ def run_autopilot_scenario(seed: int, outdir: str, replicas: int = 3,
         from mmlspark_tpu.observability import flightrec
         dumped = flightrec.dump(
             reason=f"chaos.autopilot.red.seed{seed}",
+            path=os.path.join(outdir, "chaos_flightrec.jsonl"))
+        if dumped:
+            _LOG.error("chaos: flight recorder dumped to %s", dumped)
+    return verdict
+
+
+# -- elastic scenario --------------------------------------------------------
+
+def run_elastic_scenario(seed: int, outdir: str, replicas: int = 2,
+                         requests: int = 12) -> Dict[str, Any]:
+    """SIGKILL a worker mid autopilot-driven scale-up; elasticity holds.
+
+    The supervised-elasticity rung above ``host`` (real-process restart)
+    and ``autopilot`` (in-process scale decisions): here the autopilot's
+    ``scale_up`` actuates :meth:`~mmlspark_tpu.serve.supervisor.
+    Supervisor.add_slot` — a REAL new ``mmlspark-tpu serve`` process —
+    and the seeded kill lands while that spawn is still in flight.
+
+    **Phase 1 (warm):** ``replicas`` supervised workers over a shared
+    ``runtime.compile_cache_dir`` take a seeded stream through the
+    Router, populating the disk cache every later incarnation loads
+    from.
+
+    **Phase 2 (elastic scale-up under fire):** an autopilot tick over
+    :class:`~mmlspark_tpu.serve.fleet.ProcessFleet` decides ``scale_up``
+    (``live < min_replicas``) and spawns ``w<replicas>``; the moment the
+    new child has a pid, the seeded victim — the half-spawned slot
+    itself, or an existing worker, a coin-flip of the seed — is
+    SIGKILLed, with concurrent retrying traffic in flight the whole
+    time. The ordinary supervision loop must reconcile desired == live
+    with every slot ready (the half-spawned slot either completes
+    registration or is reaped and respawned — never a zombie), and the
+    scaled-up worker must come up WARM: ``compile_cache_hits > 0`` and
+    ``compile_cache_misses == 0`` on its own ``/metrics``.
+
+    **Phase 3 (elastic scale-down):** a second autopilot (its own event
+    sidecar) decides ``scale_down`` on the idle fleet; the highest slot
+    drains through :meth:`~mmlspark_tpu.serve.supervisor.Supervisor.
+    retire_slot` and leaves the router rotation.
+
+    **Phase 4 (replay fidelity):** both pilots' event sidecars are fed
+    back through :mod:`mmlspark_tpu.control.replay` — replaying the
+    recorded signals under the recorded policy must reproduce each
+    recorded decision list byte for byte.
+
+    Invariants (verdict JSON, ``outdir/chaos_verdict.json``):
+
+    - ``zero_failed_requests``  — every streamed request scored despite
+      the kill landing mid-scale-up;
+    - ``scale_up_actuated``     — exactly one actuated ``scale_up``,
+      no actuation error, new slot named ``w<replicas>``;
+    - ``kill_landed``           — the seeded SIGKILL hit a live pid;
+    - ``desired_equals_live``   — the fleet reconciled to
+      ``replicas + 1`` workers, all ready, none mid-spawn;
+    - ``killed_slot_respawned`` — the victim slot really respawned;
+    - ``no_zombie_in_rotation`` — router rotation == supervised slots,
+      every weight restored to 1.0;
+    - ``warm_scale_up``         — the new worker loaded programs from
+      the shared cache (``compile_cache_hits > 0``);
+    - ``steady_compiles_zero``  — and compiled NOTHING
+      (``compile_cache_misses == 0``);
+    - ``scale_down_retired``    — one actuated ``scale_down`` retired
+      the new slot; desired == live == ``replicas``; slot gone from
+      rotation;
+    - ``replay_fidelity``       — both recorded decision sequences
+      replay byte-identical under their recorded policies;
+    - ``no_unhandled_exceptions``.
+
+    The ``schedule`` (kill mode + victim) is a pure function of ``seed``.
+    """
+    import threading
+    import time as _time
+    import urllib.request
+
+    import numpy as np
+
+    from mmlspark_tpu.control import replay as _replay
+    from mmlspark_tpu.control.autopilot import Autopilot, AutopilotPolicy
+    from mmlspark_tpu.observability.aggregate import parse_prometheus_text
+    from mmlspark_tpu.reliability.retry import RetryPolicy
+    from mmlspark_tpu.serve.fleet import ProcessFleet
+    from mmlspark_tpu.serve.router import Router
+    from mmlspark_tpu.serve.supervisor import ProcessSpawner, Supervisor
+    from mmlspark_tpu.utils import config as mmlconfig
+
+    os.makedirs(outdir, exist_ok=True)
+    events_dir = os.path.join(outdir, "events")
+    cache_dir = os.path.join(outdir, "compile-cache")
+    os.makedirs(events_dir, exist_ok=True)
+    os.makedirs(cache_dir, exist_ok=True)
+    errors: List[str] = []
+    verdict: Dict[str, Any] = {"seed": seed, "scenario": "elastic",
+                               "replicas": replicas, "requests": requests}
+
+    new_name = f"w{replicas}"
+    rng = random.Random(seed ^ 0xE1A5)
+    kill_new = rng.random() < 0.5
+    kill_name = new_name if kill_new else f"w{rng.randrange(replicas)}"
+    verdict["schedule"] = {
+        "kill_replica": kill_name,
+        "kill_mode": "half_spawned_slot" if kill_new
+        else "existing_worker"}
+
+    model_spec = json.dumps({"input_dim": _DIM, "hidden": [16],
+                             "num_classes": 3, "seed": seed & 0xFFFF})
+    model_flag = f"chaos=mlp_tabular:{model_spec}"
+
+    # each autopilot phase records to its OWN sidecar so phase 4 can
+    # fidelity-check one (policy, ticks, decisions) triple per log
+    prior_events = mmlconfig.get("observability.events_path")
+    up_log = os.path.join(events_dir, f"pilot-up-{os.getpid()}.jsonl")
+    down_log = os.path.join(events_dir, f"pilot-down-{os.getpid()}.jsonl")
+    mmlconfig.set("observability.events_path", up_log)
+
+    names = [f"w{i}" for i in range(replicas)]
+    spawner = ProcessSpawner([model_flag], events_dir=events_dir,
+                             compile_cache_dir=cache_dir,
+                             extra_args=["--max-batch", "4",
+                                         "--queue-depth", "32"])
+    sup = Supervisor(spawner, names, min_uptime_s=0.5, base_delay_s=0.05,
+                     max_delay_s=0.5, breaker_failures=3,
+                     breaker_reset_s=30.0)
+    client = RetryPolicy(max_attempts=8, base_delay=0.2, max_delay=2.0,
+                         jitter=0.0, name="chaos.elastic.client",
+                         seed=seed)
+    xrng = np.random.default_rng(seed)
+    stream = [xrng.normal(0, 1, (2, _DIM)).astype(np.float32)
+              for _ in range(requests)]
+    warm_n = max(2, requests // 3)
+
+    served = 0
+    failed = 0
+    killed_pid: Optional[int] = None
+    cache_hits = -1.0
+    cache_misses = -1.0
+    up_decisions: List[Dict[str, Any]] = []
+    down_decisions: List[Dict[str, Any]] = []
+    stats_up: Dict[str, Any] = {}
+    stats_down: Dict[str, Any] = {}
+    rotation_up: Dict[str, Any] = {}
+    rotation_down: Dict[str, Any] = {}
+    reconciled = False
+    router = None
+    try:
+        sup.start()
+        down = [n for n, s in sup.stats()["replicas"].items()
+                if not s["running"]]
+        if down:
+            raise ChaosError(f"workers failed to start: {down} "
+                             f"(see {events_dir}/worker-*.log)")
+        router = Router(sup.replicas, failover_attempts=replicas + 2)
+        sup.attach_router(router)
+        router.probe()
+        sup.start_monitor(0.05)
+
+        # phase 1: warm the shared compile cache through the original
+        # workers so the scaled-up incarnation can come up warm
+        for i, x in enumerate(stream[:warm_n]):
+            try:
+                y = np.asarray(client.call(router.submit, "chaos", x))
+                if y.shape[0] == 2:
+                    served += 1
+                else:
+                    failed += 1
+                    errors.append(f"request {i}: wrong shape {y.shape}")
+            except Exception as e:
+                failed += 1
+                errors.append(f"request {i}: {type(e).__name__}: {e}")
+
+        # phase 2: one autopilot tick decides scale_up (live < min) and
+        # actuates add_slot; the seeded victim is SIGKILLed the moment
+        # the new child has a pid, under concurrent retrying traffic
+        policy_up = AutopilotPolicy(
+            tick_s=1.0, min_replicas=replicas + 1,
+            max_replicas=replicas + 2, scale_up_queue=1e6,
+            scale_down_queue=0.0, scale_cooldown_s=0.0)
+        pilot_up = Autopilot(ProcessFleet(sup, router), policy=policy_up)
+
+        kill_box: Dict[str, Any] = {"pid": None}
+
+        def _killer() -> None:
+            deadline = _time.monotonic() + 60.0
+            while _time.monotonic() < deadline:
+                st = sup.stats()["replicas"].get(new_name)
+                if st is not None and st["pid"] is not None:
+                    pid = sup.kill_replica(  # lint: allow-actuate
+                        kill_name)
+                    if pid is not None:
+                        kill_box["pid"] = pid
+                        return
+                _time.sleep(0.005)
+
+        traffic_results: List[Optional[str]] = []
+
+        def _traffic() -> None:
+            for i, x in enumerate(stream[warm_n:], warm_n):
+                try:
+                    y = np.asarray(client.call(router.submit,
+                                               "chaos", x))
+                    traffic_results.append(
+                        None if y.shape[0] == 2
+                        else f"request {i}: wrong shape {y.shape}")
+                except Exception as e:
+                    traffic_results.append(
+                        f"request {i}: {type(e).__name__}: {e}")
+
+        killer = threading.Thread(target=_killer, daemon=True)
+        traffic = threading.Thread(target=_traffic, daemon=True)
+        killer.start()
+        traffic.start()
+        up_decisions = pilot_up.tick()   # blocks through add_slot
+        killer.join(60.0)
+        traffic.join(120.0)
+        killed_pid = kill_box["pid"]
+        if killed_pid is None:
+            errors.append("seeded kill never landed on a live pid")
+        if traffic.is_alive():
+            errors.append("traffic thread wedged")
+        for r in traffic_results:
+            if r is None:
+                served += 1
+            else:
+                failed += 1
+                errors.append(r)
+
+        # reconcile: the supervision loop must close the desired/live
+        # gap — every slot ready, nothing mid-spawn, no zombie
+        deadline = _time.monotonic() + 120.0
+        while _time.monotonic() < deadline:
+            st = sup.stats()
+            if (st["desired_replicas"] == replicas + 1
+                    and st["live_replicas"] == replicas + 1
+                    and st["spawns_in_flight"] == 0
+                    and all(r["ready_spawns"] == r["spawns"]
+                            and r["ready_spawns"] >= 1
+                            for r in st["replicas"].values())):
+                reconciled = True
+                stats_up = st
+                break
+            _time.sleep(0.05)
+        if not reconciled:
+            stats_up = sup.stats()
+            errors.append(f"fleet never reconciled to {replicas + 1} "
+                          f"ready workers: {stats_up['replicas']}")
+        rotation_up = {n: dict(r) for n, r in
+                       router.stats()["replicas"].items()}
+
+        # warm check: score directly on the scaled-up worker (forces
+        # its lazy program build), then read its own /metrics — a warm
+        # scale-up LOADS programs from the shared cache, compiles none
+        if reconciled:
+            rep = sup.replica(new_name)
+            y = np.asarray(rep.submit("chaos", stream[0]))
+            if y.shape[0] != 2:
+                errors.append(f"new slot: wrong shape {y.shape}")
+            with urllib.request.urlopen(f"{rep.addr}/metrics",
+                                        timeout=10) as resp:
+                parsed = parse_prometheus_text(resp.read().decode())
+            cache_hits = float(
+                parsed.get("compile_cache_hits", {}).get("value", 0.0))
+            cache_misses = float(
+                parsed.get("compile_cache_misses", {}).get("value", 0.0))
+
+            # phase 3: a second autopilot (fresh cooldowns, its own
+            # sidecar) sees the idle fleet and retires the extra slot
+            mmlconfig.set("observability.events_path", down_log)
+            policy_down = AutopilotPolicy(
+                tick_s=1.0, min_replicas=replicas,
+                max_replicas=replicas + 2, scale_up_queue=1e6,
+                scale_down_queue=0.0, scale_cooldown_s=0.0)
+            pilot_down = Autopilot(ProcessFleet(sup, router),
+                                   policy=policy_down)
+            down_decisions = pilot_down.tick()  # blocks through retire
+            stats_down = sup.stats()
+            rotation_down = {n: dict(r) for n, r in
+                             router.stats()["replicas"].items()}
+    except Exception as e:
+        errors.append(f"elastic scenario: {type(e).__name__}: {e}")
+    finally:
+        if router is not None:
+            try:
+                router.close()
+            except Exception as e:
+                _LOG.debug("router close failed: %s", e)
+        sup.shutdown(reason="chaos elastic scenario complete")
+
+    # phase 4: each pilot's sidecar must replay byte-identical under
+    # its recorded policy — the counterfactual-replay contract, checked
+    # against a REAL process-elasticity run rather than a synthetic log
+    replay_fidelity: Dict[str, Any] = {}
+    replay_ok = True
+    for label, p in (("scale_up", up_log), ("scale_down", down_log)):
+        try:
+            log = _replay.load_log([p]) if os.path.exists(p) else \
+                {"policy": None, "ticks": [], "decisions": []}
+            if not log["ticks"] or log["policy"] is None:
+                replay_fidelity[label] = {"identical": False,
+                                          "error": "no recorded ticks"}
+                replay_ok = False
+                continue
+            pol = _replay.policy_from_fields(log["policy"])
+            fid = _replay.fidelity_check(
+                log["decisions"],
+                _replay.replay_decisions(log["ticks"], pol))
+            replay_fidelity[label] = {"identical": fid["identical"],
+                                      "decisions": fid["recorded"]}
+            if not fid["identical"]:
+                replay_ok = False
+                replay_fidelity[label]["first_diff"] = fid["first_diff"]
+        except Exception as e:
+            replay_fidelity[label] = {
+                "identical": False,
+                "error": f"{type(e).__name__}: {e}"}
+            replay_ok = False
+
+    actuated_up = [d for d in up_decisions
+                   if d["action"] == "scale_up" and not d["suppressed"]]
+    actuated_down = [d for d in down_decisions
+                     if d["action"] == "scale_down"
+                     and not d["suppressed"]]
+    verdict["schedule"]["killed_pid"] = killed_pid
+    verdict["elastic"] = {
+        "served": served, "failed": failed,
+        "spawn_to_ready_ms": stats_up.get("spawn_to_ready_ms", {}),
+        "compile_cache_hits": cache_hits,
+        "compile_cache_misses": cache_misses,
+        "supervisor_after_scale_up": stats_up.get("replicas", {}),
+        "rotation_after_scale_up": sorted(rotation_up),
+        "rotation_after_scale_down": sorted(rotation_down),
+        "events_dir": events_dir}
+    verdict["replay"] = replay_fidelity
+
+    invariants = {
+        "zero_failed_requests": failed == 0 and served == requests,
+        "scale_up_actuated": (
+            len(actuated_up) == 1
+            and actuated_up[0].get("replica") == new_name
+            and "error" not in actuated_up[0]),
+        "kill_landed": killed_pid is not None,
+        "desired_equals_live": reconciled,
+        "killed_slot_respawned": (
+            stats_up.get("replicas", {}).get(kill_name, {})
+            .get("spawns", 0) >= 2),
+        "no_zombie_in_rotation": (
+            sorted(rotation_up) == sorted(stats_up.get("replicas", {}))
+            and bool(rotation_up)
+            and all(r.get("weight") == 1.0
+                    for r in rotation_up.values())),
+        "warm_scale_up": cache_hits > 0,
+        "steady_compiles_zero": cache_misses == 0,
+        "scale_down_retired": (
+            len(actuated_down) == 1
+            and actuated_down[0].get("target") == new_name
+            and "error" not in actuated_down[0]
+            and stats_down.get("desired_replicas") == replicas
+            and stats_down.get("live_replicas") == replicas
+            and new_name not in rotation_down),
+        "replay_fidelity": replay_ok,
+        "no_unhandled_exceptions": not errors,
+    }
+    verdict["invariants"] = invariants
+    verdict["errors"] = errors
+    verdict["passed"] = all(invariants.values())
+
+    # restore the prior event sink AFTER the verdict facts are gathered
+    mmlconfig.set("observability.events_path", prior_events)
+
+    path = os.path.join(outdir, VERDICT_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(verdict, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    _LOG.info("chaos elastic verdict (%s): %s", path,
+              "PASS" if verdict["passed"] else "FAIL")
+    if not verdict["passed"]:
+        from mmlspark_tpu.observability import flightrec
+        dumped = flightrec.dump(
+            reason=f"chaos.elastic.red.seed{seed}",
             path=os.path.join(outdir, "chaos_flightrec.jsonl"))
         if dumped:
             _LOG.error("chaos: flight recorder dumped to %s", dumped)

@@ -81,12 +81,15 @@ the occupancy gauges stop summing. Size arithmetic goes through
 mark the line ``# lint: allow-bytes``.
 
 Rule 12 — process management (``subprocess.Popen(...)``, ``os.kill(...)``,
-``os.waitpid(...)``) outside ``serve/supervisor.py``: child processes
-need exactly one owner — a worker spawned (or signalled) from some corner
-of the library is invisible to the supervisor's restart/backoff/breaker
-machinery and its drain path, so it leaks on shutdown and double-restarts
-under chaos. All process lifecycle goes through the supervisor;
-deliberate exceptions mark the line ``# lint: allow-process``.
+``os.waitpid(...)``) outside ``serve/supervisor.py`` /
+``serve/launcher.py``: child processes need exactly one owner per layer
+— a worker spawned (or signalled) from some corner of the library is
+invisible to the supervisor's restart/backoff/breaker machinery and its
+drain path (and a per-host fleet started outside the launcher is
+invisible to its stop/drain fan-in), so it leaks on shutdown and
+double-restarts under chaos. All process lifecycle goes through the
+supervisor (workers) or the host launcher (per-host fleets); deliberate
+exceptions mark the line ``# lint: allow-process``.
 
 Rule 13 — quantization arithmetic (``.astype(np.int8)`` /
 ``127``-range scale math) in ``serve/`` outside ``serve/kvcache.py``:
@@ -113,9 +116,11 @@ was supposed to split. Route through the sharding helpers
 
 Rule 15 — fleet actuator calls (``set_weight`` / ``kill_replica`` /
 ``scale_up`` / ``scale_down`` / ``add_replica`` / ``remove_replica`` /
-``set_capacity`` / ``reset_breaker``, plus ``.kill()`` on a
+``set_capacity`` / ``reset_breaker`` / ``add_slot`` / ``retire_slot`` /
+``launch_host`` / ``stop_host``, plus ``.kill()`` on a
 replica/fleet receiver) outside ``control/`` and the existing
-rollout/supervisor homes: every control action must stay attributable —
+rollout/supervisor/launcher homes: every control action must stay
+attributable —
 an actuation from a random module is invisible to the autopilot's
 decision telemetry (``autopilot.*`` events), so a post-mortem can no
 longer explain why a weight moved or a replica died. Route actions
@@ -193,8 +198,9 @@ _ALLOW_BYTES = "# lint: allow-bytes"
 _BYTES_HOME = "observability/memory.py"
 _BYTES_ATTRS = ("nbytes", "itemsize")
 _ALLOW_PROCESS = "# lint: allow-process"
-# the ONE module allowed to manage OS processes (it IS the supervisor)
-_PROCESS_HOME = "serve/supervisor.py"
+# the modules allowed to manage OS processes: the supervisor (worker
+# lifecycle on one host) and the host launcher (fleet-per-host fan-out)
+_PROCESS_HOMES = ("serve/supervisor.py", "serve/launcher.py")
 _PROCESS_OS_CALLS = ("kill", "waitpid")
 _ALLOW_QUANT = "# lint: allow-quant"
 # the ONE serve/ module allowed to open-code KV quantization arithmetic
@@ -209,12 +215,14 @@ _SPEC_CTORS = ("PartitionSpec", "NamedSharding")
 _ALLOW_ACTUATE = "# lint: allow-actuate"
 # the modules allowed to move fleet levers: the decision loop itself,
 # and the serve/ machinery that OWNS each lever (router weights, fleet
-# scale/rollout, supervisor restart)
+# scale/rollout, supervisor restart + slot elasticity, host launcher)
 _ACTUATE_HOMES = ("control/autopilot.py", "serve/router.py",
-                  "serve/fleet.py", "serve/supervisor.py")
+                  "serve/fleet.py", "serve/supervisor.py",
+                  "serve/launcher.py")
 _ACTUATE_CALLS = ("set_weight", "kill_replica", "scale_up", "scale_down",
                   "add_replica", "remove_replica", "set_capacity",
-                  "reset_breaker")
+                  "reset_breaker", "add_slot", "retire_slot",
+                  "launch_host", "stop_host")
 
 
 def _is_raw_sync(call: ast.Call) -> bool:
@@ -400,8 +408,9 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     alloc_scoped = "serve/" in norm and not norm.endswith(_ALLOC_HOME)
     # Rule 11 scope: serve/ modules only (the ledger home is outside it)
     bytes_scoped = "serve/" in norm and not norm.endswith(_BYTES_HOME)
-    # Rule 12 scope: everywhere, the supervisor exempt (it IS the owner)
-    process_home = norm.endswith(_PROCESS_HOME)
+    # Rule 12 scope: everywhere, the process-management homes exempt
+    # (supervisor + host launcher ARE the owners)
+    process_home = any(norm.endswith(h) for h in _PROCESS_HOMES)
     # Rule 13 scope: serve/ modules only, the quant-scheme home exempt
     quant_scoped = "serve/" in norm and not norm.endswith(_QUANT_HOME)
     # Rule 14 scope: everywhere, the sharding-policy homes exempt
@@ -541,10 +550,12 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
                 and not _process_allowed(node.lineno)):
             problems.append(
                 f"{filename}:{node.lineno}: process management "
-                f"(Popen/os.kill/os.waitpid) outside {_PROCESS_HOME} "
-                "(workers need ONE owner — the supervisor's restart/"
-                "drain machinery; route through serve.supervisor, or "
-                f"mark the line `{_ALLOW_PROCESS}`)")
+                "(Popen/os.kill/os.waitpid) outside "
+                f"{'/'.join(_PROCESS_HOMES)} (workers need ONE owner — "
+                "the supervisor's restart/drain machinery, per-host "
+                "fleets the launcher's; route through serve.supervisor "
+                f"or serve.launcher, or mark the line "
+                f"`{_ALLOW_PROCESS}`)")
         elif (isinstance(node, ast.Call) and quant_scoped
                 and _is_quant_cast(node)
                 and not _quant_allowed(node.lineno)):

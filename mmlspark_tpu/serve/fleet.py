@@ -232,12 +232,20 @@ class Fleet:
         """Retire one replica gracefully: out of the router rotation
         first (no new traffic), then drain in-flight work, then close its
         server. The inverse of :meth:`scale_up`; killing is what
-        :meth:`kill` is for."""
+        :meth:`kill` is for. Idempotent on an unknown or already-retired
+        name — the autopilot racing a crash can double-retire, and that
+        must surface as an event + no-op, not a KeyError inside the
+        control loop."""
         timeout = float(drain_timeout_s if drain_timeout_s is not None
                         else mmlconfig.get("serving.drain_timeout_s"))
         rep = next((r for r in self.replicas if r.name == name), None)
         if rep is None:
-            raise KeyError(f"unknown replica {name!r}")
+            logger.info("scale_down(%r): no such replica (already "
+                        "retired?) — no-op", name)
+            if events.recording_enabled():
+                events.emit("fleet", "scale_down_noop", replica=name,
+                            replicas=len(self.replicas))
+            return
         self.router.remove_replica(name)
         if not rep._dead:
             try:
@@ -379,6 +387,78 @@ class Fleet:
                 rep.server.close(drain=drain)
 
     def __enter__(self) -> "Fleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class ProcessFleet:
+    """A supervised process-backed fleet behind the Fleet scale surface.
+
+    The adapter that gives the autopilot real hands: same
+    ``scale_up()/scale_down(name)`` actuator signature as :class:`Fleet`,
+    but routed through :meth:`~mmlspark_tpu.serve.supervisor.Supervisor.
+    add_slot` / :meth:`~mmlspark_tpu.serve.supervisor.Supervisor.
+    retire_slot` — each replica is a real OS worker process, spawned warm
+    through the shared compile cache and drained through SIGTERM.
+    Serving calls delegate to the router (the same
+    :class:`~mmlspark_tpu.serve.router.HttpReplica` objects the
+    supervisor re-registers across restarts), so
+    :class:`~mmlspark_tpu.observability.aggregate.FleetScraper` and
+    :class:`~mmlspark_tpu.control.autopilot.Autopilot` accept either
+    fleet flavor unchanged. Selected by ``autopilot.scale_backend``.
+    """
+
+    def __init__(self, supervisor, router: Router):
+        self.supervisor = supervisor
+        self.router = router
+        if getattr(supervisor, "router", None) is None:
+            supervisor.attach_router(router)
+
+    @property
+    def replicas(self):
+        return self.supervisor.replicas
+
+    # -- serving surface ----------------------------------------------------
+    def submit(self, model: str, x, deadline_ms: Optional[float] = None,
+               **kw) -> np.ndarray:
+        return self.router.submit(model, x, deadline_ms, **kw)
+
+    def submit_generate(self, model: str, prompt,
+                        max_new_tokens: Optional[int] = None,
+                        **kw) -> Dict:
+        return self.router.submit_generate(model, prompt,
+                                           max_new_tokens, **kw)
+
+    def health(self) -> Dict[str, object]:
+        return self.router.health()
+
+    def stats(self) -> Dict[str, object]:
+        s = self.router.stats()
+        s["supervisor"] = self.supervisor.stats()
+        return s
+
+    # -- scale actuators (lint Rule 15; the autopilot's lever) --------------
+    def scale_up(self) -> str:
+        """One new supervised worker process: announce handshake,
+        ``/readyz``, router registration at full weight — warm through
+        the shared compile cache, pinned to its own chip slot. Returns
+        the new slot's name."""
+        return self.supervisor.add_slot()
+
+    def scale_down(self, name: str,
+                   drain_timeout_s: Optional[float] = None) -> None:
+        """Gracefully retire one supervised worker (weight→0, SIGTERM
+        drain, SIGKILL stragglers). Idempotent on unknown names, like
+        :meth:`Fleet.scale_down`."""
+        self.supervisor.retire_slot(name, drain_timeout_s=drain_timeout_s)
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self) -> None:
+        self.supervisor.shutdown(reason="fleet_close")
+
+    def __enter__(self) -> "ProcessFleet":
         return self
 
     def __exit__(self, *exc) -> None:

@@ -32,10 +32,21 @@ per-pid event-log sidecar, the SHARED persistent compile cache), and the
   :meth:`Supervisor.shutdown`, which SIGTERMs every child (each drains
   through its own preemption handler) and only SIGKILLs stragglers.
 
-Decisions are observable: ``supervisor.spawn|exit|backoff|restart|
-giveup|shutdown`` events flow into the event log / flight recorder and
-the report's supervisor section. Clock and sleep are injectable so the
-whole restart state machine runs under a virtual clock in tests.
+- **elasticity**: :meth:`Supervisor.add_slot` grows the fleet by one
+  supervised worker (router registration at weight 0 first, then the
+  normal announce → ``/readyz`` handshake lifts it to full weight,
+  warm through the shared compile cache and pinned to its own disjoint
+  chip slot) and :meth:`Supervisor.retire_slot` shrinks it gracefully
+  (weight→0, SIGTERM drain, SIGKILL stragglers past
+  ``serving.drain_timeout_s``, state + breaker cleaned up). These are
+  the process-level actuators the autopilot's scale lever drives
+  through :class:`~mmlspark_tpu.serve.fleet.ProcessFleet`.
+
+Decisions are observable: ``supervisor.spawn|ready|exit|backoff|restart|
+giveup|add_slot|retire|retire_noop|shutdown`` events flow into the event
+log / flight recorder and the report's supervisor section. Clock and
+sleep are injectable so the whole restart state machine runs under a
+virtual clock in tests.
 
 Lint Rule 12 makes this module the ONE home for process management
 (``subprocess.Popen``, ``os.kill``, ``os.waitpid``) in the package.
@@ -73,7 +84,8 @@ class ProcessWorker:
 
     def __init__(self, name: str, argv: Sequence[str],
                  env: Optional[Dict[str, str]] = None,
-                 log_path: Optional[str] = None):
+                 log_path: Optional[str] = None,
+                 popen: Optional[Callable] = None):
         self.name = name
         self.addr = ""
         self.announce: Dict[str, object] = {}
@@ -81,7 +93,11 @@ class ProcessWorker:
         self._log_fh = open(log_path, "ab") if log_path else None
         stderr = self._log_fh if self._log_fh is not None \
             else subprocess.DEVNULL
-        self.proc = subprocess.Popen(
+        # ``popen`` is the transport seam: the multi-host launcher wraps
+        # the argv in an ssh invocation while reusing this class's
+        # announce-handshake and drain machinery unchanged
+        launch = popen if popen is not None else subprocess.Popen
+        self.proc = launch(
             list(argv), env=env, stdout=subprocess.PIPE, stderr=stderr,
             text=True)
         self.pid = self.proc.pid
@@ -323,6 +339,8 @@ class Supervisor:
         reset_s = float(
             breaker_reset_s if breaker_reset_s is not None
             else mmlconfig.get("fleet.supervisor_breaker_reset_s"))
+        self._breaker_failures = failures
+        self._breaker_reset_s = reset_s
         self.breakers: Dict[str, CircuitBreaker] = {
             n: CircuitBreaker(f"supervisor.{n}", failure_threshold=failures,
                               reset_timeout_s=reset_s, clock=self.clock)
@@ -338,6 +356,11 @@ class Supervisor:
         self._monitor: Optional[threading.Thread] = None
         self._monitor_stop = threading.Event()
         self._restarts = metrics.counter("supervisor.restarts")
+        # elasticity bookkeeping: spawn->ready latencies (ms, most recent
+        # first-in) and the names currently mid-retire, both surfaced by
+        # stats() for the dashboard/report elasticity panel
+        self._ready_ms: List[float] = []
+        self._retiring: set = set()
 
     # -- wiring -------------------------------------------------------------
     def attach_router(self, router) -> None:
@@ -416,6 +439,14 @@ class Supervisor:
                 self.router.probe()
             except Exception as e:  # probe must not kill supervision
                 logger.warning("post-restart probe failed: %s", e)
+        ready_ms = (self.clock() - st.started_at) * 1e3
+        self._ready_ms.append(round(ready_ms, 3))
+        del self._ready_ms[:-64]   # bounded: the last 64 scale/restart events
+        if events.recording_enabled():
+            events.emit("supervisor", "ready", replica=st.name,
+                        pid=getattr(st.handle, "pid", None),
+                        attempt=st.spawns,
+                        spawn_to_ready_ms=round(ready_ms, 3))
         if st.spawns > 1:
             self._restarts.inc()
             ready_s = self.clock() - st.started_at
@@ -511,6 +542,122 @@ class Supervisor:
         h.kill()
         return pid
 
+    # -- elasticity ---------------------------------------------------------
+    def _next_name(self) -> str:
+        """Auto-name for a new slot: the smallest ``w<i>`` not in use.
+        Caller holds ``self._lock``."""
+        i = 0
+        while f"w{i}" in self._states or f"w{i}" in self._retiring:
+            i += 1
+        return f"w{i}"
+
+    def add_slot(self, name: Optional[str] = None) -> str:
+        """Grow the fleet by one supervised worker process.
+
+        Registers a fresh :class:`HttpReplica` with the router at weight
+        0.0 FIRST (so the restart machinery's weight/breaker calls always
+        find the name), then spawns through the normal announce-handshake
+        path — :meth:`_on_ready` lifts the weight to 1.0 once ``/readyz``
+        answers. A spawn that dies mid-handshake is reconciled by the
+        ordinary supervision loop: :meth:`poll_once` reaps it, schedules
+        the backoff, and respawns — the slot is never half-registered.
+        Returns the new slot's name.
+        """
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("supervisor is shut down")
+            if name is None:
+                name = self._next_name()
+            if name in self._states:
+                raise ValueError(f"replica name {name!r} already in use")
+            rep = HttpReplica("127.0.0.1:0", name=name)
+            st = _ReplicaState(name, rep)
+            self.breakers[name] = CircuitBreaker(
+                f"supervisor.{name}",
+                failure_threshold=self._breaker_failures,
+                reset_timeout_s=self._breaker_reset_s,
+                clock=self.clock)
+            self._states[name] = st
+            self.replicas.append(rep)
+        if events.recording_enabled():
+            events.emit("supervisor", "add_slot", replica=name,
+                        desired=len(self._states))
+        logger.info("adding slot %s (desired=%d)", name, len(self._states))
+        if self.router is not None:
+            self.router.add_replica(rep, weight=0.0)
+        self._spawn(st)
+        if self._closed and st.handle is not None:
+            st.handle.terminate()   # lost the race with shutdown()
+        return name
+
+    def retire_slot(self, name: str,
+                    drain_timeout_s: Optional[float] = None) -> bool:
+        """Shrink the fleet by one worker, gracefully.
+
+        Weight goes to 0 first (no new requests land), then SIGTERM lets
+        the child drain through its own preemption handler, SIGKILL
+        reaps stragglers past ``serving.drain_timeout_s``, and finally
+        the slot's router registration, state, and breaker are removed.
+        Idempotent: an unknown or already-retired name emits a
+        ``retire_noop`` event and returns False — the autopilot racing a
+        crash may double-retire, and that must not throw inside the
+        control loop.
+        """
+        with self._lock:
+            st = self._states.get(name)
+            if st is None or self._closed:
+                if events.recording_enabled():
+                    events.emit("supervisor", "retire_noop", replica=name)
+                logger.info("retire_slot(%r): no such live slot", name)
+                return False
+            del self._states[name]
+            self._retiring.add(name)
+        try:
+            if self.router is not None:
+                try:
+                    self.router.set_weight(name, 0.0)
+                except KeyError:
+                    pass  # never registered (spawn still in flight)
+            h = st.handle
+            drained = True
+            if h is not None and h.poll() is None:
+                timeout = float(
+                    drain_timeout_s if drain_timeout_s is not None
+                    else mmlconfig.get("serving.drain_timeout_s"))
+                h.terminate()
+                if h.wait(max(timeout, 0.0)) is None:
+                    drained = False
+                    logger.warning(
+                        "slot %s did not drain in %.1fs; killing",
+                        name, timeout)
+                    h.kill()
+                    h.wait(5.0)
+            if h is not None and hasattr(h, "close"):
+                h.close()
+            if self.router is not None:
+                try:
+                    self.router.remove_replica(name)
+                except KeyError:
+                    pass  # never registered
+                except ValueError:
+                    # last replica: the router refuses to go empty; the
+                    # slot stays registered at weight 0 (out of rotation)
+                    logger.warning(
+                        "slot %s is the router's last replica; left "
+                        "registered at weight 0", name)
+            with self._lock:
+                if st.replica in self.replicas:
+                    self.replicas.remove(st.replica)
+                self.breakers.pop(name, None)
+        finally:
+            self._retiring.discard(name)
+        if events.recording_enabled():
+            events.emit("supervisor", "retire", replica=name,
+                        drained=drained, desired=len(self._states))
+        logger.info("retired slot %s (drained=%s, desired=%d)",
+                    name, drained, len(self._states))
+        return True
+
     # -- monitor thread -----------------------------------------------------
     def start_monitor(self, poll_s: Optional[float] = None) -> None:
         if self._monitor is not None:
@@ -576,23 +723,56 @@ class Supervisor:
         ``desired_replicas`` vs ``live_replicas`` pair — the gap between
         "what the supervisor is supposed to keep running" and "what is
         actually up right now" that scale decisions are judged by."""
+        # lock-free on purpose (see _on_ready); add_slot/retire_slot can
+        # resize the dict mid-iteration, so snapshot with a short retry
+        states: List[_ReplicaState] = []
+        for _ in range(8):
+            try:
+                states = list(self._states.values())
+                break
+            except RuntimeError:   # dict changed size during iteration
+                continue
         reps: Dict[str, object] = {}
-        for st in self._states.values():
+        for st in states:
             h = st.handle
+            breaker = self.breakers.get(st.name)
             reps[st.name] = {
                 "pid": getattr(h, "pid", None) if h is not None else None,
                 "running": h is not None and h.poll() is None,
                 "spawns": st.spawns,
                 "ready_spawns": st.ready_spawns,
                 "consecutive_crashes": st.consecutive,
-                "breaker": self.breakers[st.name].state,
+                "breaker": breaker.state if breaker is not None
+                else "retired",
                 "addr": st.replica.addr,
             }
+        ready_ms = sorted(self._ready_ms)
+        n = len(ready_ms)
+
+        def _pct(p: float) -> float:
+            if not n:
+                return 0.0
+            return ready_ms[min(n - 1, max(0, int(p / 100.0 * n + 0.5) - 1))]
+
         return {
             "replicas": reps,
-            "desired_replicas": len(self._states),
+            "desired_replicas": len(states),
             "live_replicas": sum(1 for r in reps.values()
                                  if r["running"]),
+            # elasticity: slots mid-spawn (handle live but the current
+            # incarnation not yet through _on_ready) / mid-retire, plus
+            # the spawn->ready latency distribution over the last 64
+            "spawns_in_flight": sum(
+                1 for st in states
+                if st.handle is not None and st.handle.poll() is None
+                and st.ready_spawns < st.spawns),
+            "retiring": len(self._retiring),
+            "spawn_to_ready_ms": {
+                "count": n,
+                "p50": round(_pct(50), 3),
+                "p99": round(_pct(99), 3),
+                "max": round(ready_ms[-1], 3) if n else 0.0,
+            },
         }
 
     def __enter__(self) -> "Supervisor":

@@ -141,6 +141,12 @@ _DEFAULTS: Dict[str, Any] = {
                                       # K local chips via a per-slot
                                       # visible-devices env (CLI:
                                       # `fleet --devices-per-worker K`)
+    "fleet.hosts": "",                # comma list of hosts for the multi-
+                                      # host launcher (serve/launcher.py;
+                                      # "local" runs on this machine, any
+                                      # other name goes over ssh); "" =
+                                      # single-host supervisor fleet. CLI:
+                                      # `fleet --hosts h1,h2` / --hosts-file
     # logging
     "logging.level": "INFO",
     "logging.metrics_every": 0,       # default train-metric log cadence (steps)
@@ -212,6 +218,14 @@ _DEFAULTS: Dict[str, Any] = {
     "autopilot.window_s": 120.0,       # rolling actuation-budget window
     "autopilot.max_actions_per_window": 8,  # hard budget: decisions past
                                             # it are suppressed ("window")
+    "autopilot.scale_backend": "auto",  # what the scale lever actuates:
+                                        # "inprocess" = Fleet server
+                                        # threads, "process" = supervised
+                                        # worker processes (Supervisor.
+                                        # add_slot/retire_slot via
+                                        # ProcessFleet), "auto" = process
+                                        # when a supervisor backs the
+                                        # fleet, else in-process
 }
 
 _lock = threading.Lock()

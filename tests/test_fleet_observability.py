@@ -700,3 +700,32 @@ def test_merge_torn_lines_counter_accumulates_across_logs(tmp_path):
     # and a report built over torn logs still comes out coherent
     rep = build_report([str(p1), str(p2)])
     assert rep["events"] == 2
+
+
+def test_report_supervisor_elastic_section(tmp_path):
+    p = tmp_path / "ev-sup.jsonl"
+    _write_events(p, 300, [
+        ("supervisor", "spawn", {"replica": "a", "pid": 11}),
+        ("supervisor", "ready", {"replica": "a", "pid": 11,
+                                 "spawn_to_ready_ms": 800.0}),
+        ("supervisor", "add_slot", {"replica": "w0", "desired": 2}),
+        ("supervisor", "spawn", {"replica": "w0", "pid": 12}),
+        ("supervisor", "ready", {"replica": "w0", "pid": 12,
+                                 "spawn_to_ready_ms": 1200.0}),
+        ("supervisor", "retire", {"replica": "w0", "drained": True,
+                                  "desired": 1}),
+        ("supervisor", "retire_noop", {"replica": "w0"}),
+    ])
+    rep = build_report([str(p)])
+    el = rep["supervisor"]["elastic"]
+    assert el == {"slots_added": 1, "slots_retired": 1,
+                  "retire_noops": 1, "drained": 1, "desired_final": 1}
+    h = rep["supervisor"]["spawn_to_ready_ms"]
+    assert h["count"] == 2
+    assert h["p50"] == 800.0 and h["max"] == 1200.0
+    text = render_report([str(p)])
+    assert "elastic: 1 slot(s) added, 1 retired (1 drained cleanly)" \
+        in text
+    assert "1 retire no-op(s)" in text and "desired now 1" in text
+    assert "spawn->ready: p50 800ms, p99 1200ms, max 1200ms over " \
+        "2 spawn(s)" in text

@@ -1,0 +1,212 @@
+"""Multi-host launcher wiring, exercised entirely through fakes.
+
+The transport seam (``exec_factory``) is the point: these tests inject a
+fake exec whose ``popen`` hands back scripted processes speaking the
+one-line JSON announce protocol, so the EXACT production path —
+:class:`ProcessWorker` handshake, replica registration, drain-then-kill
+stop — runs with no ssh and no real children. ``LocalExec`` against a
+real subprocess is ``test_cli.py``'s fleet smoke's job.
+"""
+import io
+import json
+import subprocess
+
+import pytest
+
+from mmlspark_tpu.serve.launcher import (
+    HostLauncher, LocalExec, SshExec, default_exec_factory, parse_hosts,
+    read_hosts_file,
+)
+
+
+# -- host list parsing --------------------------------------------------------
+
+def test_parse_hosts_trims_and_keeps_order():
+    assert parse_hosts("h1, h2 ,h3,,") == ["h1", "h2", "h3"]
+    assert parse_hosts("") == []
+
+
+def test_parse_hosts_rejects_duplicates():
+    with pytest.raises(ValueError):
+        parse_hosts("h1,h2,h1")
+
+
+def test_read_hosts_file_skips_comments_and_blanks(tmp_path):
+    p = tmp_path / "hosts"
+    p.write_text("# fleet\nh1\n\nh2  # chips 0-3\n   \nh3\n")
+    assert read_hosts_file(str(p)) == ["h1", "h2", "h3"]
+
+
+def test_read_hosts_file_rejects_duplicates(tmp_path):
+    p = tmp_path / "hosts"
+    p.write_text("h1\nh2\nh1\n")
+    with pytest.raises(ValueError):
+        read_hosts_file(str(p))
+
+
+# -- transports ---------------------------------------------------------------
+
+def test_local_exec_wrap_is_identity():
+    assert LocalExec().wrap(["python", "-m", "x"]) == ["python", "-m", "x"]
+
+
+def test_ssh_exec_wrap_quotes_and_targets_host():
+    ex = SshExec("tpu-b", ssh_args=["-p", "2222"])
+    argv = ex.wrap(["python", "-m", "mmlspark_tpu.cli", "fleet",
+                    "--model", "bench=mlp:{\"hidden\": [16]}"])
+    assert argv[:3] == ["ssh", "-o", "BatchMode=yes"]
+    assert argv[3:5] == ["-p", "2222"]
+    assert argv[5:7] == ["tpu-b", "--"]
+    # the remote command is ONE shell-quoted string; the json-bearing
+    # model flag survives the remote shell intact
+    assert len(argv) == 8
+    assert "'bench=mlp:{\"hidden\": [16]}'" in argv[7]
+
+
+def test_default_exec_factory_routes_local_vs_ssh():
+    assert isinstance(default_exec_factory("local"), LocalExec)
+    assert isinstance(default_exec_factory("localhost"), LocalExec)
+    assert isinstance(default_exec_factory("tpu-b"), SshExec)
+
+
+# -- fakes for the launcher proper --------------------------------------------
+
+class FakeProc:
+    """A scripted child: announces once on stdout, exits on terminate."""
+
+    def __init__(self, argv, addr="127.0.0.1:7001", announce=True, **kw):
+        self.argv = list(argv)
+        self.kw = kw
+        self.pid = 4000 + (hash(addr) % 1000)
+        line = json.dumps({"serving": addr, "pid": self.pid}) + "\n"
+        self.stdout = io.StringIO(line if announce else "")
+        self.rc = None
+        self.terminated = False
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.terminated = True
+        if self.rc is None:
+            self.rc = 0          # drains clean
+
+    def wait(self, timeout=None):
+        if self.rc is None:
+            raise subprocess.TimeoutExpired(self.argv, timeout)
+        return self.rc
+
+
+class FakeExec:
+    """Transport fake: records every popen, one port per host."""
+
+    ports = {}
+
+    def __init__(self, host, spawned, announce=True):
+        self.host = host
+        self.spawned = spawned
+        self.announce = announce
+
+    def wrap(self, argv):
+        return list(argv)
+
+    def popen(self, argv, **kw):
+        port = 7000 + len(self.spawned)
+        proc = FakeProc(argv, addr=f"127.0.0.1:{port}",
+                        announce=self.announce, **kw)
+        self.spawned.append((self.host, proc))
+        return proc
+
+
+def make_launcher(hosts, spawned, *, dead_hosts=(), **kw):
+    kw.setdefault("model_flags", ["bench=mlp_tabular:{}"])
+    kw.setdefault("replicas_per_host", 2)
+    kw.setdefault("ready_timeout_s", 2.0)
+    kw.setdefault("exec_factory", lambda h: FakeExec(
+        h, spawned, announce=h not in dead_hosts))
+    return HostLauncher(hosts, **kw)
+
+
+# -- launcher -----------------------------------------------------------------
+
+def test_launcher_validates_inputs():
+    with pytest.raises(ValueError):
+        HostLauncher([], ["m"], replicas_per_host=1, ready_timeout_s=1.0)
+    with pytest.raises(ValueError):
+        HostLauncher(["h1", "h1"], ["m"], replicas_per_host=1,
+                     ready_timeout_s=1.0)
+    with pytest.raises(ValueError):
+        HostLauncher(["h1"], [], replicas_per_host=1, ready_timeout_s=1.0)
+
+
+def test_build_argv_carries_fleet_flags(tmp_path):
+    spawned = []
+    lch = make_launcher(["h1"], spawned,
+                        model_flags=["a=x:{}", "b=y:{}"],
+                        replicas_per_host=3,
+                        events_dir=str(tmp_path / "ev"),
+                        extra_args=["--port", "0"])
+    argv = lch.build_argv("h1")
+    assert argv[1:3] == ["-m", "mmlspark_tpu.cli"]
+    assert "fleet" in argv
+    i = argv.index("--replicas")
+    assert argv[i + 1] == "3"
+    assert argv.count("--model") == 2
+    assert "a=x:{}" in argv and "b=y:{}" in argv
+    j = argv.index("--events-dir")
+    assert argv[j + 1].endswith("host-h1")      # per-host sidecar dir
+    assert argv[-2:] == ["--port", "0"]
+
+
+def test_launch_host_announce_handshake_builds_replica():
+    spawned = []
+    lch = make_launcher(["h1", "h2"], spawned)
+    rep = lch.launch_host("h1")
+    assert rep.name == "host:h1"
+    assert rep.addr == "http://127.0.0.1:7000"  # normalized from announce
+    assert [h for h, _ in spawned] == ["h1"]
+    with pytest.raises(ValueError):
+        lch.launch_host("h1")                   # already launched
+    st = lch.stats()
+    assert st["desired_hosts"] == 2 and st["live_hosts"] == 1
+    assert st["hosts"]["h1"]["running"]
+    assert st["hosts"]["h1"]["announce"]["serving"] == "127.0.0.1:7000"
+    lch.shutdown()
+
+
+def test_launch_all_and_stop_host_drain():
+    spawned = []
+    lch = make_launcher(["h1", "h2"], spawned)
+    reps = lch.launch()
+    assert [r.name for r in reps] == ["host:h1", "host:h2"]
+    assert [r.name for r in lch.replicas()] == ["host:h1", "host:h2"]
+
+    assert lch.stop_host("h2") is True
+    h2 = dict(spawned)["h2"]
+    assert h2.terminated and h2.rc == 0         # SIGTERM drain, no kill
+    assert lch.stop_host("h2") is False         # idempotent
+    assert lch.stop_host("nope") is False       # unknown host: no raise
+    assert [r.name for r in lch.replicas()] == ["host:h1"]
+    lch.shutdown()
+    assert lch.workers == {} and lch.replicas() == []
+
+
+def test_launch_rolls_back_on_partial_failure():
+    # h2 never announces -> launch() must stop h1 too: no half-launched
+    # control plane left running
+    spawned = []
+    lch = make_launcher(["h1", "h2"], spawned, dead_hosts=("h2",),
+                        ready_timeout_s=0.2)
+    with pytest.raises(RuntimeError, match="h2"):
+        lch.launch()
+    assert lch.workers == {} and lch.replicas() == []
+    assert all(p.terminated for _, p in spawned)
+
+
+def test_launcher_context_manager_shuts_down():
+    spawned = []
+    with make_launcher(["h1"], spawned) as lch:
+        lch.launch()
+        assert lch.stats()["live_hosts"] == 1
+    assert lch.workers == {}
+    assert all(p.terminated for _, p in spawned)

@@ -676,3 +676,56 @@ def test_spec_rule_homes_exempt_and_marker_honored():
         def local_specs():
             return P("rows")  # lint: allow-spec (shard_map-private axis)
     """), filename="mmlspark_tpu/parallel/trainer.py") == []
+
+
+# -- Rule 15 extension: elasticity + multi-host levers are actuators ----------
+
+def test_actuate_rule_flags_elasticity_and_launcher_levers():
+    src = textwrap.dedent("""
+        def rogue(sup, launcher):
+            sup.add_slot()
+            sup.retire_slot("w0")
+            launcher.launch_host("h1")
+            launcher.stop_host("h1")
+    """)
+    probs = lint.check_source(
+        src, filename="mmlspark_tpu/serve/http.py")
+    assert len(probs) == 4
+    assert all("actuator" in p for p in probs)
+    assert "allow-actuate" in probs[0]          # the escape hatch is named
+
+
+def test_actuate_rule_lever_homes_exempt():
+    src = textwrap.dedent("""
+        def reconcile(self):
+            self.add_slot()
+            self.retire_slot("w0")
+    """)
+    # the supervisor and launcher OWN these levers
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/serve/supervisor.py") == []
+    assert lint.check_source(textwrap.dedent("""
+        def launch(self):
+            return [self.launch_host(h) for h in self.hosts]
+    """), filename="mmlspark_tpu/serve/launcher.py") == []
+    # chaos opts in per-line, same as kill_replica
+    assert lint.check_source(textwrap.dedent("""
+        def scenario(sup):
+            sup.retire_slot("w2")  # lint: allow-actuate
+    """), filename="mmlspark_tpu/reliability/chaos.py") == []
+
+
+def test_process_rule_launcher_home_exempt():
+    # Rule 12: the host launcher is a sanctioned process-management home
+    src = textwrap.dedent("""
+        import subprocess
+
+        def popen(argv, **kw):
+            return subprocess.Popen(argv, **kw)
+    """)
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/serve/launcher.py") == []
+    probs = lint.check_source(
+        src, filename="mmlspark_tpu/serve/router.py")
+    assert len(probs) == 1 and "process management" in probs[0]
+    assert "serve/launcher.py" in probs[0]      # named as a home now

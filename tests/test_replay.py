@@ -1,0 +1,220 @@
+"""Counterfactual policy replay: fidelity and ranking, no fleet.
+
+The decision core is pure, so these tests hand-build signal frames (the
+``fleet_signals`` schema) and event sidecars on disk, then drive
+``load_log -> replay_decisions -> fidelity_check / rank_policies`` and
+the ``mmlspark-tpu autopilot replay`` CLI end to end. The live-recorded
+counterpart (a real autopilot's sidecar replaying byte-identical) is the
+chaos scenarios' job.
+"""
+import dataclasses
+import json
+
+import pytest
+
+from mmlspark_tpu.cli import main
+from mmlspark_tpu.control import replay as rp
+from mmlspark_tpu.control.autopilot import AutopilotPolicy
+
+
+def _tick(now, queue, *, live=2, shed=0.0, burn=0.0, burning=False):
+    """One signal frame in the fleet_signals schema: ``live`` ready
+    replicas, uniform queue depth, monotone per-replica shed counter."""
+    reps = {
+        f"w{i}": {"ready": True, "live": True, "weight": 1.0,
+                  "queue_depth": float(queue), "inflight": 0.0,
+                  "completed": 10.0 * now, "failed": 0.0,
+                  "shed": float(shed)}
+        for i in range(live)}
+    return {"now": float(now), "replicas": reps,
+            "slo": {"burning": burning, "breaching": False,
+                    "burn_fast": float(burn)},
+            "memory": {"total_bytes": 0.0}}
+
+
+def _spike_ticks():
+    """A queue spike the recorded thresholds react to LATE: queue 3 for
+    three ticks (below the recorded scale_up_queue of 4), then 5."""
+    ticks = [_tick(0.0, 0.0)]
+    for k in range(1, 6):
+        ticks.append(_tick(10.0 * k, 3.0 if k <= 3 else 5.0,
+                           shed=4.0 * k))
+    return ticks
+
+
+RECORDED = AutopilotPolicy(min_replicas=2, max_replicas=8,
+                           scale_up_queue=4.0, scale_down_queue=0.0)
+
+
+def _write_log(path, policy, ticks, decisions, *, actuation=True):
+    """A synthetic sidecar in the exact shape the live autopilot emits:
+    one policy event, a tick event per frame, an autopilot event per
+    decision (actuated ones carry the actuation-only keys that replay
+    must strip)."""
+    ts = 0.0
+    with open(path, "w", encoding="utf-8") as fh:
+        row = {"ts": ts, "type": "autopilot_signals", "name": "policy"}
+        row.update(dataclasses.asdict(policy))
+        fh.write(json.dumps(row) + "\n")
+        for sig in ticks:
+            ts += 1.0
+            fh.write(json.dumps({"ts": ts, "type": "autopilot_signals",
+                                 "name": "tick", "signals": sig}) + "\n")
+            for d in decisions:
+                if d["t"] != sig["now"]:
+                    continue
+                row = {"ts": ts, "type": "autopilot", "name": d["action"]}
+                row.update({k: v for k, v in d.items() if k != "action"})
+                if actuation and not d["suppressed"]:
+                    row["replica"] = "w2"       # added by _actuate
+                fh.write(json.dumps(row) + "\n")
+
+
+# -- fidelity -----------------------------------------------------------------
+
+def test_replay_reproduces_recorded_decisions_byte_identical(tmp_path):
+    ticks = _spike_ticks()
+    decisions = rp.replay_decisions(ticks, RECORDED)
+    assert decisions                             # the spike does decide
+    path = tmp_path / "events.jsonl"
+    _write_log(path, RECORDED, ticks, decisions)
+
+    log = rp.load_log([str(path)])
+    assert len(log["ticks"]) == len(ticks)
+    pol = rp.policy_from_fields(log["policy"])
+    assert pol == RECORDED                       # round-trips exactly
+    fid = rp.fidelity_check(log["decisions"],
+                            rp.replay_decisions(log["ticks"], pol))
+    assert fid["identical"] is True
+    assert fid["first_diff"] is None
+    assert fid["recorded"] == fid["replayed"] == len(decisions)
+
+
+def test_fidelity_reports_first_divergence():
+    ticks = _spike_ticks()
+    recorded = rp.replay_decisions(ticks, RECORDED)
+    other = rp.replay_decisions(
+        ticks, dataclasses.replace(RECORDED, scale_up_queue=2.0))
+    fid = rp.fidelity_check(recorded, other)
+    assert fid["identical"] is False
+    assert fid["first_diff"] is not None
+    assert fid["first_diff"]["index"] >= 0
+
+
+def test_load_log_merges_files_and_skips_garbage(tmp_path):
+    ticks = _spike_ticks()
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _write_log(a, RECORDED, ticks[:3], [])
+    # second sidecar: later ticks plus a line truncated by a kill
+    with open(b, "w", encoding="utf-8") as fh:
+        for i, sig in enumerate(ticks[3:]):
+            fh.write(json.dumps({"ts": 100.0 + i, "type":
+                                 "autopilot_signals", "name": "tick",
+                                 "signals": sig}) + "\n")
+        fh.write('{"ts": 999, "type": "autopilot_si')
+    log = rp.load_log([str(b), str(a)])          # order given != ts order
+    assert len(log["ticks"]) == len(ticks)
+    # merged in ts order: file a's frames (ts 1..3) come first
+    assert [t["now"] for t in log["ticks"]] == [t["now"] for t in ticks]
+
+
+# -- counterfactual ranking ---------------------------------------------------
+
+def test_rank_orders_early_scaler_above_recorded_above_lazy():
+    ticks = _spike_ticks()
+    candidates = {
+        "recorded": RECORDED,
+        "aggressive": dataclasses.replace(RECORDED, scale_up_queue=2.0),
+        "lazy": dataclasses.replace(RECORDED, scale_up_queue=100.0),
+    }
+    ranked = rp.rank_policies(ticks, candidates)
+    assert [s["policy"] for s in ranked] == ["aggressive", "recorded",
+                                             "lazy"]
+    assert [s["rank"] for s in ranked] == [1, 2, 3]
+    # earlier capacity -> strictly less counterfactual shed
+    assert ranked[0]["shed"] < ranked[1]["shed"] < ranked[2]["shed"]
+    assert ranked[0]["scale_ups"] > ranked[1]["scale_ups"] == 1
+    assert ranked[2]["scale_ups"] == 0
+    assert ranked[2]["final_virtual_replicas"] == 2
+
+    out = rp.format_ranking(ranked, rp.fidelity_check([], []))
+    assert "fidelity: OK" in out
+    assert out.index("aggressive") < out.index("lazy")
+
+
+def test_score_policy_counts_only_actuated_decisions():
+    ticks = _spike_ticks()
+    s = rp.score_policy(ticks, RECORDED)
+    replayed = rp.replay_decisions(ticks, RECORDED)
+    actuated = [d for d in replayed if not d["suppressed"]]
+    assert s["actions"] == len(actuated)
+    assert s["ticks"] == len(ticks)
+
+
+# -- policy reconstruction ----------------------------------------------------
+
+def test_policy_from_fields_overrides_and_coercion():
+    fields = dataclasses.asdict(RECORDED)
+    pol = rp.policy_from_fields(fields, {"min_replicas": 3.0,
+                                         "scale_up_queue": 2})
+    assert pol.min_replicas == 3 and isinstance(pol.min_replicas, int)
+    assert pol.scale_up_queue == 2
+    assert pol.window_s == RECORDED.window_s     # untouched fields kept
+    with pytest.raises(ValueError, match="scale_up_quue"):
+        rp.policy_from_fields(fields, {"scale_up_quue": 2.0})
+    # unknown RECORDED keys (e.g. a future field) are ignored, not fatal
+    assert rp.policy_from_fields({**fields, "new_knob": 1}) == RECORDED
+
+
+def test_parse_overrides():
+    assert rp.parse_overrides(
+        "scale_up_queue=2, scale_cooldown_s=10.5,") == {
+            "scale_up_queue": 2, "scale_cooldown_s": 10.5}
+    with pytest.raises(ValueError):
+        rp.parse_overrides("scale_up_queue")
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def test_cli_replay_ranks_and_exits_by_fidelity(tmp_path, capsys):
+    ticks = _spike_ticks()
+    decisions = rp.replay_decisions(ticks, RECORDED)
+    path = tmp_path / "events.jsonl"
+    _write_log(path, RECORDED, ticks, decisions)
+
+    rc = main(["autopilot", "replay", str(path),
+               "--candidate", "agg:scale_up_queue=2"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "fidelity: OK" in out
+    assert "agg" in out and "recorded" in out
+
+    rc = main(["autopilot", "replay", str(path), "--json"])
+    verdict = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert verdict["fidelity"]["identical"] is True
+    assert verdict["ranking"][0]["policy"] == "recorded"
+
+    # a log whose decisions do NOT match its recorded policy breaks the
+    # replay-sufficiency contract: exit 1, loudly
+    bad = tmp_path / "bad.jsonl"
+    _write_log(bad, dataclasses.replace(RECORDED, scale_up_queue=2.0),
+               ticks, decisions)
+    rc = main(["autopilot", "replay", str(bad)])
+    assert rc == 1
+    assert "MISMATCH" in capsys.readouterr().out
+
+
+def test_cli_replay_rejects_bad_flags(tmp_path):
+    ticks = _spike_ticks()
+    path = tmp_path / "events.jsonl"
+    _write_log(path, RECORDED, ticks, [])
+    with pytest.raises(SystemExit):
+        main(["autopilot", "replay", str(path), "--candidate", "nolabel"])
+    with pytest.raises(SystemExit):
+        main(["autopilot", "replay", str(path),
+              "--candidate", "x:not_a_field=1"])
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    with pytest.raises(SystemExit, match="no autopilot_signals"):
+        main(["autopilot", "replay", str(empty)])

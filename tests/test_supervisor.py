@@ -93,13 +93,36 @@ class DeadSpawner:
 
 
 class FakeRouter:
+    """Mirrors the real Router's registration semantics: set_weight on
+    an unknown name KeyErrors, removing the last replica ValueErrors."""
+
     def __init__(self, names):
         self.weights = {n: 1.0 for n in names}
         self.resets = []
         self.probes = 0
+        self.added = []
+        self.removed = []
+        self.weight_trace = []
+
+    def add_replica(self, rep, weight=1.0):
+        if rep.name in self.weights:
+            raise ValueError(f"duplicate replica {rep.name}")
+        self.weights[rep.name] = float(weight)
+        self.added.append((rep.name, float(weight)))
+
+    def remove_replica(self, name):
+        if name not in self.weights:
+            raise KeyError(name)
+        if len(self.weights) == 1:
+            raise ValueError("cannot remove the last replica")
+        del self.weights[name]
+        self.removed.append(name)
 
     def set_weight(self, name, w):
+        if name not in self.weights:
+            raise KeyError(name)
         self.weights[name] = float(w)
+        self.weight_trace.append((name, float(w)))
 
     def reset_breaker(self, name):
         self.resets.append(name)
@@ -394,7 +417,7 @@ def test_chaos_scenario_registry_covers_all_runners():
     from mmlspark_tpu.reliability import chaos
     assert set(chaos.SCENARIOS) == {"train", "fleet", "decode", "host",
                                     "fleet_sharded", "decode_sharded",
-                                    "autopilot"}
+                                    "autopilot", "elastic"}
     assert all(desc for desc in chaos.SCENARIOS.values())
 
 
@@ -444,3 +467,221 @@ def test_chaos_host_schedule_deterministic(tmp_path):
     for key in ("kill_at", "kill_replica"):
         assert v1["schedule"][key] == v2["schedule"][key]
     assert v1["crash_loop"] == v2["crash_loop"]   # pure virtual clock
+
+
+# -- elasticity: add_slot / retire_slot ---------------------------------------
+
+def test_add_slot_weight_lifecycle(tmp_path):
+    """A new slot registers at weight 0, spawns, and only _on_ready
+    lifts it to full weight (with a fleet-breaker reset)."""
+    ev_path = tmp_path / "events.jsonl"
+    mmlconfig.set("observability.events_path", str(ev_path))
+    try:
+        clock = VClock()
+        sp = FakeSpawner()
+        sup = make_sup(sp, ["a"], clock)
+        router = FakeRouter(["a"])
+        sup.attach_router(router)
+        sup.start()
+
+        name = sup.add_slot()
+        assert name == "w0"                      # smallest unused w<i>
+        assert router.added == [("w0", 0.0)]     # registered BEFORE spawn
+        assert router.weights["w0"] == 1.0       # lifted by _on_ready
+        assert "w0" in router.resets
+        assert "w0" in sup.breakers
+        full = sup.stats()
+        assert full["desired_replicas"] == 2
+        assert full["live_replicas"] == 2
+        assert full["spawns_in_flight"] == 0
+        assert full["replicas"]["w0"]["ready_spawns"] == 1
+        assert full["spawn_to_ready_ms"]["count"] >= 1
+
+        with pytest.raises(ValueError):
+            sup.add_slot(name="a")               # duplicate name
+    finally:
+        mmlconfig.unset("observability.events_path")
+        events.close()
+    sup_events = [json.loads(line) for line in
+                  ev_path.read_text().splitlines()
+                  if json.loads(line)["type"] == "supervisor"]
+    names = [e["name"] for e in sup_events]
+    assert "add_slot" in names and "ready" in names
+    add = next(e for e in sup_events if e["name"] == "add_slot")
+    assert add["replica"] == "w0" and add["desired"] == 2
+    ready = next(e for e in sup_events
+                 if e["name"] == "ready" and e["replica"] == "w0")
+    assert ready["spawn_to_ready_ms"] >= 0.0
+
+
+def test_add_slot_dead_spawn_reconciles_via_poll():
+    """A slot whose first spawn dies mid-handshake is reaped by the
+    ordinary supervision loop and respawned at full saved weight —
+    never a half-registered zombie."""
+    clock = VClock()
+
+    class DieFirstSpawner(FakeSpawner):
+        def spawn(self, name):
+            h = super().spawn(name)
+            if name == "w0" and len(self.handles["w0"]) == 1:
+                h.rc = 1                     # dead before /readyz
+            return h
+
+    sp = DieFirstSpawner()
+    sup = make_sup(sp, ["a"], clock)
+    router = FakeRouter(["a"])
+    sup.attach_router(router)
+    sup.start()
+
+    name = sup.add_slot()
+    assert name == "w0"
+    assert router.weights["w0"] == 0.0           # never lifted
+    st = sup.stats()["replicas"]["w0"]
+    assert st["spawns"] == 1 and st["ready_spawns"] == 0
+
+    sup.poll_once()                              # reap + schedule backoff
+    assert sup.stats()["replicas"]["w0"]["running"] is False
+    clock.advance(2.0)                           # base_delay
+    sup.poll_once()                              # respawn, now live
+    st = sup.stats()["replicas"]["w0"]
+    assert st["running"] and st["ready_spawns"] == st["spawns"] == 2
+    # the slot never carried traffic, so it re-enters at FULL weight
+    assert router.weights["w0"] == 1.0
+
+
+def test_retire_slot_drain_ordering(tmp_path):
+    """Retire: weight->0 strictly before SIGTERM, removal from the
+    router after the drain, state + breaker cleaned up."""
+    ev_path = tmp_path / "events.jsonl"
+    mmlconfig.set("observability.events_path", str(ev_path))
+    try:
+        clock = VClock()
+        sp = FakeSpawner()
+        sup = make_sup(sp, ["a", "b"], clock)
+        router = FakeRouter(["a", "b"])
+        sup.attach_router(router)
+        sup.start()
+
+        h = sp.handles["b"][0]
+        weight_at_terminate = {}
+        orig_terminate = h.terminate
+
+        def spy_terminate():
+            weight_at_terminate["b"] = router.weights["b"]
+            orig_terminate()
+
+        h.terminate = spy_terminate
+        assert sup.retire_slot("b") is True
+        assert weight_at_terminate["b"] == 0.0   # drained AFTER weight->0
+        assert h.closed
+        assert router.removed == ["b"]
+        assert "b" not in sup.breakers
+        full = sup.stats()
+        assert full["desired_replicas"] == 1
+        assert "b" not in full["replicas"]
+        assert len(sup.replicas) == 1
+    finally:
+        mmlconfig.unset("observability.events_path")
+        events.close()
+    sup_events = [json.loads(line) for line in
+                  ev_path.read_text().splitlines()
+                  if json.loads(line)["type"] == "supervisor"]
+    retire = next(e for e in sup_events if e["name"] == "retire")
+    assert retire["replica"] == "b" and retire["drained"] is True
+    assert retire["desired"] == 1
+
+
+def test_retire_slot_idempotent_noop(tmp_path):
+    ev_path = tmp_path / "events.jsonl"
+    mmlconfig.set("observability.events_path", str(ev_path))
+    try:
+        clock = VClock()
+        sup = make_sup(FakeSpawner(), ["a", "b"], clock)
+        sup.attach_router(FakeRouter(["a", "b"]))
+        sup.start()
+        assert sup.retire_slot("nope") is False   # unknown: no KeyError
+        assert sup.retire_slot("b") is True
+        assert sup.retire_slot("b") is False      # double-retire: no-op
+    finally:
+        mmlconfig.unset("observability.events_path")
+        events.close()
+    noops = [json.loads(line) for line in ev_path.read_text().splitlines()
+             if json.loads(line)["type"] == "supervisor"
+             and json.loads(line)["name"] == "retire_noop"]
+    assert [e["replica"] for e in noops] == ["nope", "b"]
+
+
+def test_retire_last_replica_stays_registered_at_zero():
+    """The router refuses to go empty; the retired last slot stays
+    registered at weight 0 (out of rotation) instead of raising."""
+    clock = VClock()
+    sup = make_sup(FakeSpawner(), ["a"], clock)
+    router = FakeRouter(["a"])
+    sup.attach_router(router)
+    sup.start()
+    assert sup.retire_slot("a") is True
+    assert router.weights == {"a": 0.0}          # registered, weightless
+    assert sup.stats()["desired_replicas"] == 0
+
+
+def test_retire_slot_sigkills_straggler():
+    clock = VClock()
+    sp = FakeSpawner()
+    sup = make_sup(sp, ["a", "b"], clock)
+    sup.attach_router(FakeRouter(["a", "b"]))
+    sup.start()
+    h = sp.handles["b"][0]
+    h.terminate = lambda: None                   # ignores SIGTERM
+    h.wait = lambda timeout=None: None if not h.killed else -9
+    assert sup.retire_slot("b", drain_timeout_s=0.0) is True
+    assert h.killed                              # SIGKILL past the budget
+
+
+def test_add_slot_closed_supervisor_raises():
+    clock = VClock()
+    sup = make_sup(FakeSpawner(), ["a"], clock)
+    sup.start()
+    sup.shutdown()
+    with pytest.raises(RuntimeError):
+        sup.add_slot()
+
+
+def test_process_fleet_routes_scale_through_supervisor():
+    from mmlspark_tpu.serve.fleet import ProcessFleet
+    clock = VClock()
+    sup = make_sup(FakeSpawner(), ["a"], clock)
+    router = FakeRouter(["a"])
+    fleet = ProcessFleet(sup, router)
+    assert sup.router is router                  # auto-attached
+    sup.start()
+    name = fleet.scale_up()
+    assert name == "w0" and router.weights["w0"] == 1.0
+    stats = fleet.stats()
+    assert stats["supervisor"]["desired_replicas"] == 2
+    fleet.scale_down("w0")
+    assert "w0" not in router.weights
+    fleet.scale_down("w0")                       # idempotent, no raise
+    assert sup.stats()["desired_replicas"] == 1
+
+
+def test_top_dashboard_supervisor_panel():
+    from mmlspark_tpu.observability.dashboard import TopDashboard
+
+    class StubScraper:
+        def scrape(self):
+            return {"ts": 0.0, "fleet": {}, "replicas": {},
+                    "memory": {}, "scrape_ms": 0.1}
+
+    class StubSup:
+        def stats(self):
+            return {"desired_replicas": 3, "live_replicas": 2,
+                    "spawns_in_flight": 1, "retiring": 0,
+                    "spawn_to_ready_ms": {"count": 2, "p50": 900.0,
+                                          "p99": 1500.0, "max": 1500.0}}
+
+    dash = TopDashboard(StubScraper(), supervisor=StubSup())
+    frame = dash.tick()
+    assert "workers" in frame
+    assert "desired 3" in frame and "live 2 (!)" in frame
+    assert "spawning 1" in frame
+    assert "spawn->ready p50 900ms" in frame
