@@ -318,6 +318,41 @@ def cmd_top(args, passthrough) -> int:
     return 0
 
 
+def cmd_loadgen(args, passthrough) -> int:
+    """Preview a seeded open-loop workload schedule (testing/loadgen):
+    prints the trace spec, arrival count, offered QPS, per-bucket
+    arrival counts, and the sha256 schedule fingerprint — the replay
+    contract (same seed + trace -> same fingerprint, byte for byte)."""
+    from mmlspark_tpu.testing import loadgen
+    trace = loadgen.Trace(
+        duration_s=args.duration, rate=args.rate, shape=args.shape,
+        process=args.process, spike_start_s=args.spike_start,
+        spike_len_s=args.spike_len, spike_factor=args.spike_factor,
+        pareto_alpha=args.pareto_alpha,
+        session_turns=args.session_turns, think_s=args.think)
+    schedule = loadgen.generate(trace, args.seed)
+    fingerprint = loadgen.schedule_fingerprint(schedule)
+    buckets = loadgen.bucket_counts(schedule, args.bucket) \
+        if args.bucket > 0 else []
+    offered_qps = (len(schedule) / trace.duration_s
+                   if trace.duration_s > 0 else 0.0)
+    if getattr(args, "json", False):
+        print(json.dumps({  # lint: allow-print
+            "trace": trace.describe(), "seed": args.seed,
+            "arrivals": len(schedule), "fingerprint": fingerprint,
+            "offered_qps": round(offered_qps, 4),
+            "bucket_s": args.bucket, "buckets": buckets},
+            sort_keys=True))
+        return 0
+    print(f"trace: {trace.describe()}")  # lint: allow-print
+    print(f"seed {args.seed}: {len(schedule)} arrivals "  # lint: allow-print
+          f"({offered_qps:.2f} offered qps)")
+    if buckets:
+        print(f"per-{args.bucket:g}s buckets: {buckets}")  # lint: allow-print
+    print(f"fingerprint: {fingerprint}")  # lint: allow-print
+    return 0
+
+
 def _parse_model_flag(text: str):
     """``NAME=ARCH[:JSON-kwargs]`` -> (name, architecture, kwargs)."""
     name, sep, rest = text.partition("=")
@@ -1053,6 +1088,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     top_p.add_argument("--timeout", type=float, default=2.0,
                        help="per-replica scrape timeout in seconds")
     top_p.set_defaults(fn=cmd_top)
+
+    loadgen_p = sub.add_parser(
+        "loadgen", help="preview a seeded open-loop workload schedule")
+    loadgen_p.add_argument("--rate", type=float, default=8.0,
+                           help="base arrivals/second (default 8)")
+    loadgen_p.add_argument("--duration", type=float, default=10.0,
+                           help="trace length in seconds (default 10)")
+    loadgen_p.add_argument("--shape", default="constant",
+                           choices=["constant", "diurnal", "spike"],
+                           help="rate curve (default constant)")
+    loadgen_p.add_argument("--process", default="poisson",
+                           choices=["poisson", "pareto"],
+                           help="arrival process (default poisson)")
+    loadgen_p.add_argument("--spike-start", type=float, default=0.0,
+                           help="spike window start (s)")
+    loadgen_p.add_argument("--spike-len", type=float, default=0.0,
+                           help="spike window length (s)")
+    loadgen_p.add_argument("--spike-factor", type=float, default=1.0,
+                           help="rate multiplier inside the spike window")
+    loadgen_p.add_argument("--pareto-alpha", type=float, default=1.5,
+                           help="pareto tail shape (must be > 1)")
+    loadgen_p.add_argument("--session-turns", type=int, default=1,
+                           help="max turns per session (default 1: no "
+                           "sessions)")
+    loadgen_p.add_argument("--think", type=float, default=0.0,
+                           help="inter-turn think time (s)")
+    loadgen_p.add_argument("--seed", type=int, default=0,
+                           help="schedule seed (default 0)")
+    loadgen_p.add_argument("--bucket", type=float, default=1.0,
+                           help="bucket size for per-bucket counts "
+                           "(default 1s; 0 disables)")
+    loadgen_p.add_argument("--json", action="store_true",
+                           help="emit the preview as one JSON object")
+    loadgen_p.set_defaults(fn=cmd_loadgen)
 
     args = parser.parse_args(argv)
     try:

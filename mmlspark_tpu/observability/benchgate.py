@@ -12,7 +12,25 @@ one-line JSON result against the committed baseline per lane:
   above it;
 - ``shed_rate`` / ``spike_p99_ms`` (the autopilot lane) must not rise
   more than the tolerance above it — a controller change that sheds
-  more or recovers slower under the seeded spike is a regression.
+  more or recovers slower under the seeded spike is a regression;
+- ``goodput`` (open-loop lanes: fraction of OFFERED requests answered
+  within the lane's deadline) must not drop more than the tolerance
+  below it, and ``arrival_p99_ms`` (latency from the INTENDED arrival
+  time, un-clipped) must not rise more than the tolerance above it.
+
+Clipped percentiles are never parity evidence. A latency percentile
+that sits exactly at the lane's ``deadline_ms`` — or that the lane
+marks ``<field>_clipped`` — is a FLOOR, not a value: the true
+percentile is somewhere above it. So a clipped fresh value against an
+un-clipped baseline is a regression outright (the fresh run saturated
+where the baseline did not), while any comparison against a clipped
+baseline is demoted to informational (``clipped-vs-clipped`` showing
+90000 vs 90000 proves nothing — exactly the blind spot that hid the
+r08 spike regression). A legacy baseline lane that predates the
+open-loop rework (it has ``spike_p99_ms`` but neither ``deadline_ms``
+nor ``arrival_p99_ms``) cannot even be tested for clipping, so its
+``spike_p99_ms`` is informational too — the transition can never
+false-fail.
 
 A lane that was budget-skipped (or terminated) in EITHER run is marked
 ``skipped``, never red — congestion on the bench host must not fail CI.
@@ -60,6 +78,34 @@ def _num(lane: Dict[str, Any], field: str) -> Optional[float]:
     return float(v)
 
 
+# latency percentiles that can saturate at a lane deadline; everything in
+# the clipped-handling path below applies to these and only these
+_LATENCY_FIELDS = ("ttft_p99_ms", "spike_p99_ms", "arrival_p99_ms")
+
+
+def clipped(lane: Dict[str, Any], field: str) -> bool:
+    """Is this lane's latency percentile a deadline-saturated FLOOR
+    rather than a measured value? True when the lane says so outright
+    (``<field>_clipped``) or when the value sits EXACTLY at the lane's
+    ``deadline_ms`` — the saturated-top-bucket signature. An honest
+    open-loop measurement ABOVE the deadline is not clipped: that is a
+    real (bad) number, and gating it is the whole point."""
+    if lane.get(f"{field}_clipped") is True:
+        return True
+    v = _num(lane, field)
+    d = _num(lane, "deadline_ms")
+    return v is not None and d is not None and d > 0 and v == d
+
+
+def _legacy_closed_loop(lane: Dict[str, Any]) -> bool:
+    """A pre-open-loop baseline lane: it reports ``spike_p99_ms`` but
+    carries neither the deadline nor the arrival-time percentile, so its
+    latency numbers cannot even be tested for clipping (r08 and earlier
+    committed 90000.0-clipped values as if they were measurements)."""
+    return ("spike_p99_ms" in lane and "deadline_ms" not in lane
+            and "arrival_p99_ms" not in lane)
+
+
 def _check(name: str, fresh_v: Optional[float], base_v: Optional[float],
            tolerance: float, higher_is_better: bool) -> Optional[Dict[str, Any]]:
     """One metric comparison; None when either side can't be checked
@@ -101,20 +147,45 @@ def compare(fresh: Dict[str, Any], baseline: Dict[str, Any],
             lanes[name] = {"status": "skipped", "reasons": [str(reason)]}
             skipped.append(name)
             continue
-        checks = [c for c in (
-            _check("value", _num(fresh_lane, "value"),
-                   _num(base_lane, "value"), tolerance, True),
-            _check("step_ms", _num(fresh_lane, "step_ms"),
-                   _num(base_lane, "step_ms"), tolerance, False),
-            _check("mfu", _num(fresh_lane, "mfu"),
-                   _num(base_lane, "mfu"), tolerance, True),
-            _check("ttft_p99_ms", _num(fresh_lane, "ttft_p99_ms"),
-                   _num(base_lane, "ttft_p99_ms"), tolerance, False),
-            _check("shed_rate", _num(fresh_lane, "shed_rate"),
-                   _num(base_lane, "shed_rate"), tolerance, False),
-            _check("spike_p99_ms", _num(fresh_lane, "spike_p99_ms"),
-                   _num(base_lane, "spike_p99_ms"), tolerance, False),
-        ) if c is not None]
+        checks = []
+        for field, higher in (("value", True), ("step_ms", False),
+                              ("mfu", True), ("ttft_p99_ms", False),
+                              ("shed_rate", False),
+                              ("spike_p99_ms", False),
+                              ("goodput", True),
+                              ("arrival_p99_ms", False)):
+            c = _check(field, _num(fresh_lane, field),
+                       _num(base_lane, field), tolerance, higher)
+            if c is None:
+                continue
+            if field in _LATENCY_FIELDS:
+                fresh_clip = clipped(fresh_lane, field)
+                base_clip = clipped(base_lane, field)
+                if fresh_clip:
+                    c["clipped"] = True
+                if base_clip:
+                    c["baseline_clipped"] = True
+                legacy = (field == "spike_p99_ms"
+                          and _legacy_closed_loop(base_lane))
+                if base_clip or legacy:
+                    # the baseline number is a floor (or can't be told
+                    # from one): a ratio against it proves nothing in
+                    # either direction — report, never red, and never
+                    # count clipped-vs-clipped as parity
+                    c["ok"] = True
+                    c["informational"] = True
+                    c["note"] = (
+                        "clipped-vs-clipped: not parity evidence"
+                        if fresh_clip and base_clip else
+                        "baseline is a clipped/legacy closed-loop "
+                        "floor; not comparable")
+                elif fresh_clip:
+                    # the fresh run saturated where the baseline did
+                    # not — a regression even at ratio 1.0
+                    c["ok"] = False
+                    c["note"] = ("fresh percentile clipped at the "
+                                 "deadline; baseline was un-clipped")
+            checks.append(c)
         # compile_ms / cold_start_ms are INFORMATIONAL: cold-start cost
         # swings with cache state and host load, so the comparison is
         # reported (so the compile-cache win is a visible number) but can
@@ -150,6 +221,7 @@ def compare(fresh: Dict[str, Any], baseline: Dict[str, Any],
             f"{c['metric']}: {c['fresh']:g} vs baseline "
             f"{c['baseline']:g} (ratio {c['ratio']:g}, "
             f"tolerance {c['tolerance']:g})"
+            + (f" — {c['note']}" if c.get("note") else "")
             for c in checks if not c["ok"]]
         status = "red" if reasons else "green"
         if reasons:

@@ -2,7 +2,10 @@
 
 A live terminal dashboard over :class:`FleetScraper` + :class:`SloEngine`
 — per-replica ready/draining, queue depth, QPS, p50/p99, shed rate, SLO
-burn, HBM occupancy, and (when a generate lane is live) the decode line:
+burn, HBM occupancy, the open-loop workload line when one is live
+(offered vs delivered QPS, goodput under deadline, un-clipped
+arrival-time p99 — a GoodputMeter passed as ``workload=`` or scraped
+``workload.*`` gauges), and (when a generate lane is live) the decode line:
 prefix-cache hit rate, CoW copies, speculation acceptance, int8 arena
 savings — for watching a ``Fleet.rollout`` or a chaos run in real time. Deliberately curses-free: each frame is a plain string and
 the live loop just re-homes the cursor with ANSI ``ESC[H ESC[J`` before
@@ -53,7 +56,7 @@ class TopDashboard:
 
     def __init__(self, scraper: FleetScraper,
                  engine: Optional[SloEngine] = None, *,
-                 autopilot=None, supervisor=None,
+                 autopilot=None, supervisor=None, workload=None,
                  clock: Optional[Callable[[], float]] = None,
                  out=None, interval_s: float = 2.0):
         self.scraper = scraper
@@ -64,6 +67,10 @@ class TopDashboard:
         # anything with a Supervisor-shaped stats() dict; the panel shows
         # desired vs live plus the elasticity in flight
         self.supervisor = supervisor
+        # anything with a GoodputMeter-shaped result() dict (an open-loop
+        # driver in this process); without one, the line falls back to
+        # scraped ``workload.*`` gauges when a replica exports them
+        self.workload = workload
         self.clock = clock or events.wall
         self.out = out if out is not None else sys.stdout
         self.interval_s = float(interval_s)
@@ -108,6 +115,29 @@ class TopDashboard:
         if shed_rate is not None:
             parts.append(f"shed/s {shed_rate:.1f}")
         lines.append("fleet    " + "  ".join(parts))
+
+        # open-loop workload truth: offered vs delivered and the
+        # un-clipped arrival-time p99 against the deadline — from a live
+        # GoodputMeter when the driver runs in-process, else from the
+        # ``workload.*`` gauges a replica exported
+        wl: Optional[Dict[str, Any]] = None
+        if self.workload is not None:
+            wl = self.workload.result()
+        elif any(k.startswith("workload.") for k in fleet):
+            wl = {k.split(".", 1)[1]: v for k, v in fleet.items()
+                  if k.startswith("workload.")}
+        if wl:
+            parts = [
+                f"offered {float(wl.get('offered', 0)):.0f}",
+                f"delivered {float(wl.get('delivered', 0)):.0f}",
+                f"goodput {100.0 * float(wl.get('goodput', 0.0)):.1f}%",
+                f"arrival p99 {float(wl.get('arrival_p99_ms', 0.0)):.1f}ms"
+                f" (deadline {float(wl.get('deadline_ms', 0.0)):.0f}ms)"]
+            shed_n = float(wl.get("shed", 0))
+            exp_n = float(wl.get("expired", 0))
+            if shed_n or exp_n:
+                parts.append(f"shed {shed_n:.0f}  expired {exp_n:.0f}")
+            lines.append("workload " + "  ".join(parts))
 
         # generative decode lane: fleet totals hold summed
         # ``generate.<model>.<key>`` stats; match on exact key depth so

@@ -69,8 +69,24 @@ def percentile_from_buckets(cumulative: Dict[Any, Any], p: float) -> float:
 
     Linear interpolation within the bucket containing the target rank;
     a rank that lands in the ``+Inf`` overflow bucket clamps to the
-    highest finite bound (there is no upper edge to interpolate to).
+    highest finite bound (there is no upper edge to interpolate to) —
+    callers that must distinguish a clamp from a real value use
+    :func:`percentile_from_buckets_ex`, which reports it explicitly.
     Empty histograms return 0.0.
+    """
+    return percentile_from_buckets_ex(cumulative, p)[0]
+
+
+def percentile_from_buckets_ex(cumulative: Dict[Any, Any],
+                               p: float) -> Tuple[float, bool]:
+    """:func:`percentile_from_buckets` plus an explicit CLIPPED flag.
+
+    Returns ``(value, clipped)``: ``clipped`` is True when the target
+    rank lands in the ``+Inf`` overflow bucket, i.e. the returned value
+    is the highest finite bound acting as a floor, NOT an estimate — the
+    true percentile is somewhere above it and unbounded. Benchgate uses
+    this to refuse clipped-vs-clipped latency comparisons as parity
+    (a deadline-saturated p99 says "at least this bad", never "equal").
     """
     finite = []
     inf_count: Optional[float] = None
@@ -87,16 +103,17 @@ def percentile_from_buckets(cumulative: Dict[Any, Any], p: float) -> float:
     total = inf_count if inf_count is not None else (
         finite[-1][1] if finite else 0.0)
     if total <= 0:
-        return 0.0
+        return 0.0, False
     rank = max(0.0, min(100.0, float(p))) / 100.0 * total
     prev_bound, prev_cum = 0.0, 0.0
     for bound, cum in finite:
         if cum >= rank:
             span = cum - prev_cum
             frac = (rank - prev_cum) / span if span > 0 else 1.0
-            return prev_bound + (bound - prev_bound) * frac
+            return prev_bound + (bound - prev_bound) * frac, False
         prev_bound, prev_cum = bound, cum
-    return finite[-1][0] if finite else 0.0
+    # the rank lives in the +Inf overflow: the clamp is a floor
+    return (finite[-1][0] if finite else 0.0), bool(finite)
 
 
 def escape_label_value(value: str) -> str:

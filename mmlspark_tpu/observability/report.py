@@ -25,6 +25,11 @@ print where the time went —
   ``serving.request`` events (p50/p99 total latency, mean queue/pad/compute
   split, batch occupancy) plus shed/expired counts, the shed rate, and
   tail-sampled slow-request trace ids;
+- workload: the open-loop driver's honesty section — per-lane
+  ``workload.summary`` events (observability/goodput.py): offered vs
+  delivered QPS, goodput under the deadline, shed/expired split, and
+  the UN-clipped arrival-time p50/p99 with the worst time-bucket's p99
+  and its trace_id exemplar;
 - generative serving: TTFT/ITL percentiles, token counts, KV-arena
   occupancy and decode-step facts from the generate lane's
   ``generate.request`` / ``decode.step`` events, plus shed/expired
@@ -289,6 +294,34 @@ def build_report(path, top: int = 10,
             (100.0 * len(shed) / offered) if offered else 0.0, 2)
         sv["expired"] = len(expired)
         report["serving"] = sv
+
+    # -- workload (open-loop goodput summaries from GoodputMeter.export) ---
+    wl_ev = [e for e in events
+             if e.get("type") == "workload" and e.get("name") == "summary"]
+    if wl_ev:
+        lanes = []
+        for e in wl_ev:
+            lane: Dict[str, Any] = {
+                "lane": str(e.get("lane", "") or "-"),
+                "offered": int(e.get("offered", 0)),
+                "delivered": int(e.get("delivered", 0)),
+                "shed": int(e.get("shed", 0)),
+                "expired": int(e.get("expired", 0)),
+                "goodput": float(e.get("goodput", 0.0)),
+                "deadline_ms": float(e.get("deadline_ms", 0.0)),
+                "offered_qps": float(e.get("offered_qps", 0.0)),
+                "delivered_qps": float(e.get("delivered_qps", 0.0)),
+                "arrival_p50_ms": float(e.get("arrival_p50_ms", 0.0)),
+                "arrival_p99_ms": float(e.get("arrival_p99_ms", 0.0)),
+            }
+            worst = e.get("worst_bucket")
+            if isinstance(worst, dict):
+                lane["worst_bucket"] = {
+                    "t0": worst.get("t0"),
+                    "p99_ms": worst.get("p99_ms"),
+                    "trace_id": worst.get("trace_id")}
+            lanes.append(lane)
+        report["workload"] = lanes
 
     # -- generative serving (generate.* + decode.* events) ----------------
     gen_ev = [e for e in events if e.get("type") == "generate"]
@@ -723,6 +756,28 @@ def render_report(path, top: int = 10) -> str:
                        f"{len(sv['slow_traces'])} [{detail}]")
         out.append(f"  shed: {sv['shed']} ({sv['shed_rate']:.1f}% of "
                    f"offered), expired: {sv['expired']}")
+        out.append("")
+
+    if "workload" in r:
+        out.append("workload (open-loop, latency from intended arrival):")
+        for wl in r["workload"]:
+            out.append(
+                f"  [{wl['lane']}] offered {wl['offered']} "
+                f"({wl['offered_qps']:.2f} qps), delivered "
+                f"{wl['delivered']} ({wl['delivered_qps']:.2f} qps); "
+                f"goodput {wl['goodput'] * 100:.1f}% under "
+                f"{wl['deadline_ms']:.0f}ms deadline")
+            out.append(
+                f"    shed {wl['shed']}, expired {wl['expired']}; "
+                f"arrival p50={wl['arrival_p50_ms']:.1f}ms "
+                f"p99={wl['arrival_p99_ms']:.1f}ms (un-clipped)")
+            worst = wl.get("worst_bucket")
+            if worst and worst.get("p99_ms") is not None:
+                line = (f"    worst bucket @t={worst['t0']:.0f}s: "
+                        f"p99={worst['p99_ms']:.1f}ms")
+                if worst.get("trace_id"):
+                    line += f" (trace {worst['trace_id']})"
+                out.append(line)
         out.append("")
 
     if "generate" in r:

@@ -42,6 +42,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from mmlspark_tpu.reliability.faults import (FaultPlan, FaultSpec,
                                              InjectedFault)
+from mmlspark_tpu.testing import loadgen
 from mmlspark_tpu.utils.logging import get_logger
 
 _LOG = get_logger("reliability.chaos")
@@ -178,8 +179,7 @@ def _batch_fn(seed: int) -> Callable[[int], Dict[str, Any]]:
     import numpy as np
 
     def batch(step: int) -> Dict[str, Any]:
-        rng = np.random.default_rng((seed << 20) + step)
-        x = rng.normal(0, 1, (16, _DIM)).astype(np.float32)
+        x = loadgen.feature_rows(1, 16, _DIM, (seed << 20) + step)[0]
         return {"x": x, "y": (x * 0.5).astype(np.float32)}
 
     return batch
@@ -294,13 +294,13 @@ def _serve_phase(seed: int, requests: int,
             polls_bad += 1
             errors.append(f"healthz poll failed: {type(e).__name__}: {e}")
 
-    rng = np.random.default_rng(seed)
+    stream = loadgen.feature_rows(requests, 3, _DIM, seed)
     served = 0
     injected = 0
     plan = generate_serve_plan(seed, requests)
     with plan:
         for i in range(requests):
-            x = rng.normal(0, 1, (3, _DIM)).astype(np.float32)
+            x = stream[i]
             try:
                 y = server.submit("chaos", x, timeout=30)
                 if np.asarray(y).shape[0] == 3:
@@ -413,9 +413,7 @@ def run_fleet_scenario(seed: int, outdir: str, replicas: int = 3,
                      **({"meshSpec": mesh} if mesh else {}))
     model.set_model("mlp_tabular", input_dim=_DIM, hidden=[16],
                     num_classes=3, seed=seed & 0xFFFF)
-    xrng = np.random.default_rng(seed)
-    stream = [xrng.normal(0, 1, (2, _DIM)).astype(np.float32)
-              for _ in range(requests)]
+    stream = loadgen.feature_rows(requests, 2, _DIM, seed)
 
     # phase 1: single-server reference (same model object -> same programs)
     ref_server = Server({"chaos": model}, max_batch=4, queue_depth=32)
@@ -636,11 +634,11 @@ def run_decode_scenario(seed: int, outdir: str, replicas: int = 2,
     rng = random.Random(seed ^ 0xDEC0DE)
     kill_req = rng.randint(requests // 3, max(requests // 3,
                                               (2 * requests) // 3))
-    prompts = [[rng.randrange(1, 200)
-                for _ in range(rng.randint(3, 8))]
-               for _ in range(requests)]
-    # the victim generates long enough that the kill lands mid-decode
-    max_new = [24 if i == kill_req else rng.randint(4, 8)
+    prompts = loadgen.token_prompts(requests, rng, vocab=200,
+                                    min_len=3, max_len=8)
+    # the victim generates long enough that the kill lands mid-decode;
+    # decode lengths are scenario parameters, not a payload stream
+    max_new = [24 if i == kill_req else rng.randint(4, 8)  # lint: allow-handload
                for i in range(requests)]
 
     # a tiny arena keeps compile cost down; restore the config afterwards
@@ -816,7 +814,10 @@ def _run_shared_prefix_kill(model, rng, seed: int,
     from mmlspark_tpu.utils import config as mmlconfig
 
     bt = int(mmlconfig.get("generate.kv_block_tokens"))
-    sysp = [rng.randrange(1, 200) for _ in range(3 * bt)]  # 3 full blocks
+    # one shared system prompt of 3 full KV blocks, from the shared-prefix
+    # population vocabulary (rank-0 prefix of a 1-prefix population)
+    sysp = loadgen.PromptPopulation(
+        rng, prefixes=1, prefix_tokens=3 * bt, vocab=200).prefix(0)
     pa, pb = sysp + [11, 12], sysp + [21, 22]
     max_new = 10
 
@@ -1050,9 +1051,7 @@ def run_host_scenario(seed: int, outdir: str, replicas: int = 2,
                      breaker_reset_s=30.0)
     client = RetryPolicy(max_attempts=6, base_delay=0.2, max_delay=2.0,
                          jitter=0.0, name="chaos.host.client", seed=seed)
-    xrng = np.random.default_rng(seed)
-    stream = [xrng.normal(0, 1, (2, _DIM)).astype(np.float32)
-              for _ in range(requests)]
+    stream = loadgen.feature_rows(requests, 2, _DIM, seed)
 
     served = 0
     failed = 0
@@ -1223,11 +1222,24 @@ def run_host_scenario(seed: int, outdir: str, replicas: int = 2,
 
 def _autopilot_drive(model, stream, arrivals, *, kill_round: int,
                      kill_idx: int, replicas: int, policy,
-                     events_path: str = "") -> Dict[str, Any]:
-    """One fleet pass through the seeded spike schedule — the shared
+                     events_path: str = "",
+                     deadline_s: float = 90.0) -> Dict[str, Any]:
+    """One fleet pass through the seeded open-loop schedule — the shared
     driver behind both halves of the autopilot scenario (and the
     ``serving_autopilot`` bench lane). ``policy=None`` is the static
     fleet: same arrivals, same kill, no controller.
+
+    OPEN loop: ``arrivals`` (per-round offered counts, normally
+    ``loadgen.bucket_counts`` of a seeded trace) keeps offering no
+    matter how wedged the fleet is, and every request's latency is
+    measured from its ARRIVAL round — a retry after a kill does not
+    restart its clock (the re-enqueue-time accounting this replaces was
+    coordinated omission: both halves of the r08 spike read exactly
+    90000.0 ms because the deadline clipped what the retries hid). The
+    returned ``workload`` dict is the
+    :class:`~mmlspark_tpu.observability.goodput.GoodputMeter` verdict:
+    goodput under ``deadline_s``, offered/delivered QPS, and the
+    un-clipped arrival-time percentiles.
 
     No executor threads: every replica is a ``start=False``
     :class:`~mmlspark_tpu.serve.server.Server` stepped with
@@ -1240,6 +1252,7 @@ def _autopilot_drive(model, stream, arrivals, *, kill_round: int,
 
     from mmlspark_tpu.control.autopilot import Autopilot
     from mmlspark_tpu.observability.aggregate import FleetScraper
+    from mmlspark_tpu.observability.goodput import GoodputMeter
     from mmlspark_tpu.observability.slo import SloEngine
     from mmlspark_tpu.serve.fleet import Fleet
     from mmlspark_tpu.serve.server import ServerClosed, ServerOverloaded
@@ -1263,6 +1276,8 @@ def _autopilot_drive(model, stream, arrivals, *, kill_round: int,
 
     scores: Dict[int, Any] = {}
     lat_rounds: Dict[int, int] = {}
+    arrival_round: Dict[int, int] = {}   # intended arrival, NOT re-enqueue
+    meter = GoodputMeter(deadline_s=deadline_s, bucket_s=30.0)
     shed = 0
     hard_failed = 0
     pending: List[tuple] = []   # (idx, replica, future, enqueue_round)
@@ -1270,6 +1285,9 @@ def _autopilot_drive(model, stream, arrivals, *, kill_round: int,
     decisions: List[Dict[str, Any]] = []
     trace: List[Dict[str, Any]] = []
     next_req = 0
+
+    def _tid(idx: int) -> str:
+        return f"q{idx:06d}"
 
     def enqueue(idx: int, rnd: int) -> None:
         nonlocal shed
@@ -1279,15 +1297,18 @@ def _autopilot_drive(model, stream, arrivals, *, kill_round: int,
                  if not r._dead and weights.get(r.name, 0.0) > 0.0]
         if not cands:
             shed += 1
+            meter.shed(_tid(idx))
             return
         # deterministic spread: shortest queue wins, name breaks ties
         rep = min(cands, key=lambda r: (
             r.server.stats().get("queue_depth", 0), r.name))
         try:
-            fut = rep.server.submit_async("chaos", stream[idx])
+            fut = rep.server.submit_async("chaos", stream[idx],
+                                          trace_id=_tid(idx))
             pending.append((idx, rep, fut, rnd))
         except (ServerOverloaded, ServerClosed):
             shed += 1
+            meter.shed(_tid(idx))
 
     def step_round(rnd: int, new_arrivals: int) -> None:
         nonlocal pending, hard_failed, retries
@@ -1295,7 +1316,10 @@ def _autopilot_drive(model, stream, arrivals, *, kill_round: int,
             fleet.kill(kill_idx)  # lint: allow-actuate
         this_round, retries = retries, []
         nonlocal next_req
-        this_round += list(range(next_req, next_req + new_arrivals))
+        for idx in range(next_req, next_req + new_arrivals):
+            arrival_round[idx] = rnd
+            meter.offer(_tid(idx), vclock["t"])
+            this_round.append(idx)
         next_req += new_arrivals
         for idx in this_round:
             enqueue(idx, rnd)
@@ -1311,11 +1335,15 @@ def _autopilot_drive(model, stream, arrivals, *, kill_round: int,
                 exc = fut.exception()
                 if exc is None:
                     scores[idx] = np.asarray(fut.result())
-                    lat_rounds[idx] = rnd - enq
+                    # arrival-time truth: the clock started when the
+                    # request was OFFERED, not when a retry re-entered
+                    lat_rounds[idx] = rnd - arrival_round[idx]
+                    meter.complete(_tid(idx), vclock["t"])
                 elif isinstance(exc, (ServerOverloaded, ServerClosed)):
                     retries.append(idx)   # the kill shed it; try again
                 else:
                     hard_failed += 1
+                    meter.expire(_tid(idx))
             elif rep._dead:
                 retries.append(idx)       # future died with the replica
             else:
@@ -1363,6 +1391,11 @@ def _autopilot_drive(model, stream, arrivals, *, kill_round: int,
                 int(s.get("registry.compiles", 0))
                 for s in fleet.stats()["servers"].values()),
         }
+        # workload verdict (goodput, offered/delivered QPS, un-clipped
+        # arrival percentiles) — exported while the event log is still
+        # ours so `report` can render the workload section for this run
+        workload = meter.export(
+            lane="autopilot" if policy is not None else "static")
     finally:
         if events_path:
             from mmlspark_tpu.utils import config as mmlconfig
@@ -1372,6 +1405,7 @@ def _autopilot_drive(model, stream, arrivals, *, kill_round: int,
         fleet.close()
 
     return {"scores": scores, "latency_rounds": lat_rounds,
+            "arrival_rounds": arrival_round, "workload": workload,
             "shed": shed, "hard_failed": hard_failed,
             "unresolved": len(pending) + len(retries),
             "decisions": decisions, "trace": trace, "final": final}
@@ -1468,22 +1502,29 @@ def run_autopilot_scenario(seed: int, outdir: str, replicas: int = 3,
     kill_round = spike_start + rng.randint(1, 3)
     kill_idx = rng.randrange(replicas)
     base_rate, spike_rate = 2, 18
-    arrivals = [spike_rate
-                if spike_start <= r < spike_start + spike_len
-                else base_rate for r in range(rounds)]
-    total_requests = sum(arrivals)
+    # the open-loop schedule: a seeded Poisson flash-crowd trace from the
+    # shared load vocabulary (testing/loadgen), bucketed into 30 s rounds
+    # — same (seed, trace) replays the identical schedule, which the
+    # fingerprint records
+    trace_spec = loadgen.Trace(
+        duration_s=rounds * 30.0, rate=base_rate / 30.0, shape="spike",
+        spike_start_s=spike_start * 30.0, spike_len_s=spike_len * 30.0,
+        spike_factor=spike_rate / base_rate)
+    schedule = loadgen.generate(trace_spec, seed)
+    arrivals = loadgen.bucket_counts(schedule, 30.0, rounds)
+    total_requests = len(schedule)
     verdict["schedule"] = {
         "spike_start": spike_start, "spike_len": spike_len,
         "spike_rate": spike_rate, "base_rate": base_rate,
         "kill_round": kill_round, "kill_replica": f"r{kill_idx}",
+        "trace": trace_spec.describe(),
+        "fingerprint": loadgen.schedule_fingerprint(schedule),
         "total_requests": total_requests}
 
     model = JaxModel(inputCol="x", outputCol="y", miniBatchSize=8)
     model.set_model("mlp_tabular", input_dim=_DIM, hidden=[16],
                     num_classes=3, seed=seed & 0xFFFF)
-    xrng = np.random.default_rng(seed)
-    stream = [xrng.normal(0, 1, (2, _DIM)).astype(np.float32)
-              for _ in range(total_requests)]
+    stream = loadgen.feature_rows(total_requests, 2, _DIM, seed)
 
     # every fleet server (founding AND autopilot-scaled) must load its
     # bucket programs from the shared on-disk cache the reference server
@@ -1545,10 +1586,12 @@ def run_autopilot_scenario(seed: int, outdir: str, replicas: int = 3,
         rounds)
     verdict["static"] = {"shed": static["shed"],
                          "served": len(static["scores"]),
-                         "hard_failed": static["hard_failed"]}
+                         "hard_failed": static["hard_failed"],
+                         "workload": static["workload"]}
     verdict["autopilot"] = {
         "shed": auto["shed"], "served": len(auto["scores"]),
         "hard_failed": auto["hard_failed"],
+        "workload": auto["workload"],
         "decisions": len(auto["decisions"]),
         "actuated": len(acted), "by_action": by_action,
         "suppressed": flap["suppressed_events"],
@@ -1718,9 +1761,7 @@ def run_elastic_scenario(seed: int, outdir: str, replicas: int = 2,
     client = RetryPolicy(max_attempts=8, base_delay=0.2, max_delay=2.0,
                          jitter=0.0, name="chaos.elastic.client",
                          seed=seed)
-    xrng = np.random.default_rng(seed)
-    stream = [xrng.normal(0, 1, (2, _DIM)).astype(np.float32)
-              for _ in range(requests)]
+    stream = loadgen.feature_rows(requests, 2, _DIM, seed)
     warm_n = max(2, requests // 3)
 
     served = 0

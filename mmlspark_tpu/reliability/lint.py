@@ -128,6 +128,17 @@ through ``control.autopilot`` (or the fleet/supervisor machinery that
 owns them); deliberate out-of-band actuations (a chaos scenario's kill,
 an operator script) mark the line ``# lint: allow-actuate``.
 
+Rule 16 — hand-rolled load construction in ``reliability/chaos.py``:
+a private ``default_rng(...)`` generator, or ``randrange``/``randint``
+draws inside a comprehension, is how a scenario builds its own request
+stream — payloads and prompts that exist outside the shared, seeded
+workload vocabulary (``testing/loadgen``: ``generate`` schedules,
+``feature_rows``, ``token_prompts``, ``PromptPopulation``) and
+therefore outside the byte-identical replay contract the open-loop
+rework established. Scenarios draw load ONLY from loadgen; a
+deliberate hand-rolled stream marks the line
+``# lint: allow-handload``.
+
 Shared core for ``tools/check_reliability.py`` (standalone CLI),
 ``mmlspark-tpu check`` (installed CLI), and the in-pytest gate
 (tests/test_reliability_lint.py) — same single source of truth pattern as
@@ -223,6 +234,14 @@ _ACTUATE_CALLS = ("set_weight", "kill_replica", "scale_up", "scale_down",
                   "add_replica", "remove_replica", "set_capacity",
                   "reset_breaker", "add_slot", "retire_slot",
                   "launch_host", "stop_host")
+_ALLOW_HANDLOAD = "# lint: allow-handload"
+# the ONE module chaos scenarios may construct load through (schedules,
+# feature streams, token prompts, prefix populations — all seeded,
+# all replayable)
+_HANDLOAD_HOME = "testing/loadgen.py"
+# Rule 16 scope: the chaos scenario harness only
+_HANDLOAD_SCOPE = "reliability/chaos.py"
+_HANDLOAD_DRAWS = ("randrange", "randint")
 
 
 def _is_raw_sync(call: ast.Call) -> bool:
@@ -382,6 +401,16 @@ def _is_actuator_call(call: ast.Call) -> bool:
     return "replica" in name.lower() or "fleet" in name.lower()
 
 
+def _is_handload_rng(call: ast.Call) -> bool:
+    """``default_rng(...)`` in any spelling (``np.random.default_rng``,
+    an aliased import, a bare name) — a private numpy Generator is the
+    signature of a scenario hand-rolling its own feature stream instead
+    of drawing from :data:`_HANDLOAD_HOME`."""
+    f = call.func
+    return (isinstance(f, ast.Name) and f.id == "default_rng") or \
+        (isinstance(f, ast.Attribute) and f.attr == "default_rng")
+
+
 def _is_signal_signal(call: ast.Call) -> bool:
     """``signal.signal(...)`` (or any ``<x>.signal(...)`` attribute call on
     a name ending in ``signal``) — the handler-installation form. A bare
@@ -417,6 +446,8 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     spec_scoped = not any(norm.endswith(h) for h in _SPEC_HOMES)
     # Rule 15 scope: everywhere, the decision loop + lever owners exempt
     actuate_scoped = not any(norm.endswith(h) for h in _ACTUATE_HOMES)
+    # Rule 16 scope: the chaos scenario harness only
+    handload_scoped = norm.endswith(_HANDLOAD_SCOPE)
 
     def _allowed(lineno: int) -> bool:
         # marker anywhere on the offending line opts that line out
@@ -462,6 +493,33 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     def _actuate_allowed(lineno: int) -> bool:
         return (0 < lineno <= len(lines)
                 and _ALLOW_ACTUATE in lines[lineno - 1])
+
+    def _handload_allowed(lineno: int) -> bool:
+        return (0 < lineno <= len(lines)
+                and _ALLOW_HANDLOAD in lines[lineno - 1])
+
+    if handload_scoped:
+        # Rule 16, comprehension form: randrange/randint draws inside a
+        # list/generator comprehension are a prompt/payload stream being
+        # built inline — needs its own pass because the draw's context
+        # (the comprehension) is what makes it load construction
+        for comp in ast.walk(tree):
+            if not isinstance(comp, (ast.ListComp, ast.GeneratorExp,
+                                     ast.SetComp)):
+                continue
+            for sub in ast.walk(comp):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in _HANDLOAD_DRAWS
+                        and not _handload_allowed(sub.lineno)):
+                    problems.append(
+                        f"{filename}:{sub.lineno}: hand-rolled load "
+                        f"construction ({sub.func.attr} in a "
+                        f"comprehension) in chaos (request streams come "
+                        f"from {_HANDLOAD_HOME} — feature_rows/"
+                        "token_prompts/PromptPopulation — so they stay "
+                        "seeded and replayable; mark deliberate "
+                        f"exceptions `{_ALLOW_HANDLOAD}`)")
 
     for node in ast.walk(tree):
         if (isinstance(node, ast.Call)
@@ -594,6 +652,16 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
                 "actions must stay attributable in the autopilot's "
                 "decision telemetry; route through control.autopilot, "
                 f"or mark the line `{_ALLOW_ACTUATE}`)")
+        elif (isinstance(node, ast.Call) and handload_scoped
+                and _is_handload_rng(node)
+                and not _handload_allowed(node.lineno)):
+            problems.append(
+                f"{filename}:{node.lineno}: hand-rolled load "
+                "construction (private default_rng generator) in chaos "
+                f"(request streams come from {_HANDLOAD_HOME} — "
+                "feature_rows/token_prompts/PromptPopulation — so they "
+                "stay seeded and replayable; mark deliberate "
+                f"exceptions `{_ALLOW_HANDLOAD}`)")
         elif (isinstance(node, ast.Call) and _is_raw_sync(node)
                 and not sync_home
                 and not _sync_allowed(node.lineno)):

@@ -316,3 +316,22 @@ def test_hosts_contract_two_process_launch(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out}"
         assert f"HOSTS-OK {i}" in out, out
+
+
+def test_cli_loadgen_emits_deterministic_schedule_json(capsys):
+    argv = ["loadgen", "--rate", "4", "--duration", "10", "--shape",
+            "spike", "--spike-start", "2", "--spike-len", "3",
+            "--seed", "7", "--bucket", "2", "--json"]
+    assert main(argv) == 0
+    a = json.loads(capsys.readouterr().out)
+    assert main(argv) == 0
+    b = json.loads(capsys.readouterr().out)
+    # same (seed, trace) -> byte-identical schedule, same fingerprint
+    assert a == b
+    assert a["seed"] == 7 and a["arrivals"] > 0
+    assert len(a["fingerprint"]) == 64
+    assert sum(a["buckets"]) == a["arrivals"]
+    assert len(a["buckets"]) == 5
+    assert main(["loadgen", "--rate", "2", "--duration", "3"]) == 0
+    text = capsys.readouterr().out
+    assert "fingerprint" in text

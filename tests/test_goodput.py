@@ -1,0 +1,204 @@
+"""observability/goodput: arrival-time-truth serving measurement.
+
+The measurement half of the open-loop rework: latency from INTENDED
+arrival, goodput as within-deadline completions over OFFERED requests
+(shed and expired mass counts against it, never vanishes), un-clipped
+percentiles, time-bucketed series with trace_id exemplars, and export
+through the existing events/metrics plumbing so ``report`` and ``top``
+render the workload section.
+"""
+import json
+
+import pytest
+
+from mmlspark_tpu.observability import events
+from mmlspark_tpu.observability import metrics as obsmetrics
+from mmlspark_tpu.observability.goodput import GoodputMeter
+from mmlspark_tpu.utils import config
+
+
+@pytest.fixture
+def registry():
+    reg = obsmetrics.get_registry()
+    reg.reset()
+    yield reg
+    reg.reset()
+
+
+@pytest.fixture
+def events_file(tmp_path, registry):
+    path = str(tmp_path / "events.jsonl")
+    config.set("observability.events_path", path)
+    try:
+        yield path
+    finally:
+        events.close()
+        events.reset_clock()
+        config.unset("observability.events_path")
+
+
+def _meter():
+    m = GoodputMeter(deadline_s=1.0, bucket_s=10.0)
+    m.offer("a", 0.0)
+    m.offer("b", 1.0)
+    m.offer("c", 2.0)
+    m.offer("d", 3.0)
+    m.complete("a", 0.5)      # 500 ms: within deadline
+    m.complete("b", 6.0)      # 5000 ms: completed but busted — un-clipped
+    m.shed("c")
+    m.expire("d")
+    return m
+
+
+def test_goodput_counts_shed_and_busted_against_offered():
+    res = _meter().result()
+    assert res["offered"] == 4 and res["delivered"] == 2
+    assert res["shed"] == 1 and res["expired"] == 1
+    assert res["unresolved"] == 0
+    # only "a" answered within the 1 s deadline: 1/4 offered
+    assert res["goodput"] == 0.25
+    assert res["deadline_ms"] == 1000.0
+
+
+def test_percentiles_are_unclipped_and_over_completions_only():
+    res = _meter().result()
+    # p99 over the two completions is the REAL 5000 ms, not the deadline
+    assert res["arrival_p99_ms"] == 5000.0
+    assert res["arrival_max_ms"] == 5000.0
+    assert res["arrival_p50_ms"] in (500.0, 5000.0)
+
+
+def test_latency_runs_from_intended_arrival_not_send():
+    m = GoodputMeter(deadline_s=1.0)
+    m.offer("q", 10.0)
+    # completion at t=13 against an INTENDED arrival of t=10: 3 s, even
+    # if the actual send was throttled to t=12.9
+    assert m.complete("q", 13.0) == pytest.approx(3.0)
+
+
+def test_outcome_before_offer_is_an_error():
+    m = GoodputMeter(deadline_s=1.0)
+    with pytest.raises(KeyError, match="before offer"):
+        m.complete("ghost", 1.0)
+    with pytest.raises(KeyError, match="before offer"):
+        m.shed("ghost")
+
+
+def test_buckets_carry_worst_trace_exemplar():
+    m = GoodputMeter(deadline_s=1.0, bucket_s=10.0)
+    m.offer("fast", 0.0)
+    m.offer("slow", 1.0)
+    m.offer("late.q", 15.0)
+    m.complete("fast", 0.1)
+    m.complete("slow", 8.0)       # 7 s — the worst in bucket 0
+    m.complete("late.q", 15.2)
+    res = m.result()
+    assert len(res["buckets"]) == 2
+    b0, b1 = res["buckets"]
+    assert b0["offered"] == 2 and b0["trace_id"] == "slow"
+    assert b0["p99_ms"] == pytest.approx(7000.0)
+    assert b1["offered"] == 1 and b1["trace_id"] == "late.q"
+    # the worst bucket (with WHEN and WHICH) is surfaced directly
+    assert res["worst_bucket"]["trace_id"] == "slow"
+    assert res["worst_bucket"]["t0"] == 0.0
+
+
+def test_offered_and_delivered_qps_over_the_observed_span():
+    m = GoodputMeter(deadline_s=1.0)
+    m.offer("a", 0.0)
+    m.offer("b", 10.0)
+    m.complete("a", 0.5)
+    res = m.result()
+    assert res["offered_qps"] == pytest.approx(0.2)    # 2 over 10 s
+    assert res["delivered_qps"] == pytest.approx(0.1)
+
+
+def test_export_emits_workload_summary_event_and_gauges(events_file,
+                                                        registry):
+    config.set("observability.metrics", True)
+    try:
+        res = _meter().export(lane="unit")
+        events.close()
+        with open(events_file) as f:
+            evs = [json.loads(line) for line in f if line.strip()]
+        wl = [e for e in evs if e.get("type") == "workload"
+              and e.get("name") == "summary"]
+        assert len(wl) == 1 and wl[0]["lane"] == "unit"
+        assert wl[0]["goodput"] == res["goodput"] == 0.25
+        assert wl[0]["arrival_p99_ms"] == 5000.0
+        assert "buckets" not in wl[0]          # series stays out of the event
+        assert registry.gauge("workload.goodput").value == 0.25
+        assert registry.gauge("workload.offered").value == 4.0
+        assert registry.gauge(
+            "workload.arrival_p99_ms").value == 5000.0
+        assert registry.gauge(
+            "workload.worst_bucket_p99_ms").value == 5000.0
+    finally:
+        config.unset("observability.metrics")
+
+
+def test_export_is_quiet_when_telemetry_disabled(tmp_path, registry):
+    res = _meter().export(lane="quiet")
+    assert res["offered"] == 4                 # still returns the verdict
+    assert registry.to_dict() == {}            # no gauges registered
+
+
+def test_invalid_construction_rejected():
+    with pytest.raises(ValueError):
+        GoodputMeter(deadline_s=0.0)
+    with pytest.raises(ValueError):
+        GoodputMeter(deadline_s=1.0, bucket_s=-1.0)
+
+
+# ------------------------------------------------- report + top rendering
+def test_report_renders_workload_section(events_file):
+    _meter().export(lane="chaos.autopilot")
+    events.close()
+    from mmlspark_tpu.observability.report import build_report, render_report
+    rep = build_report(events_file)
+    assert len(rep["workload"]) == 1
+    wl = rep["workload"][0]
+    assert wl["lane"] == "chaos.autopilot"
+    assert wl["offered"] == 4 and wl["delivered"] == 2
+    assert wl["goodput"] == 0.25
+    assert wl["arrival_p99_ms"] == 5000.0
+    assert wl["worst_bucket"]["trace_id"] == "b"
+    text = render_report(events_file)
+    assert "workload (open-loop, latency from intended arrival):" in text
+    assert "goodput 25.0% under 1000ms deadline" in text
+    assert "p99=5000.0ms (un-clipped)" in text
+    assert "trace b" in text
+
+
+def test_top_dashboard_renders_live_meter_workload_line(registry):
+    from mmlspark_tpu.observability.dashboard import TopDashboard
+
+    class _Scraper:
+        def scrape(self):
+            return {"ts": 1.0, "fleet": {}, "replicas": {},
+                    "memory": {}, "scrape_ms": 0.1}
+
+    dash = TopDashboard(_Scraper(), workload=_meter())
+    frame = dash.render(dash.scraper.scrape())
+    assert "workload offered 4  delivered 2  goodput 25.0%" in frame
+    assert "arrival p99 5000.0ms (deadline 1000ms)" in frame
+    assert "shed 1  expired 1" in frame
+
+
+def test_top_dashboard_falls_back_to_scraped_workload_gauges(registry):
+    from mmlspark_tpu.observability.dashboard import TopDashboard
+
+    class _Scraper:
+        def scrape(self):
+            return {"ts": 1.0, "replicas": {}, "memory": {},
+                    "scrape_ms": 0.1,
+                    "fleet": {"workload.offered": 10.0,
+                              "workload.delivered": 9.0,
+                              "workload.goodput": 0.9,
+                              "workload.arrival_p99_ms": 120.0,
+                              "workload.deadline_ms": 250.0}}
+
+    dash = TopDashboard(_Scraper())
+    frame = dash.render(dash.scraper.scrape())
+    assert "workload offered 10  delivered 9  goodput 90.0%" in frame
+    assert "arrival p99 120.0ms (deadline 250ms)" in frame

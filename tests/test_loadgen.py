@@ -1,0 +1,263 @@
+"""testing/loadgen: seeded open-loop workload generation.
+
+The tentpole contract under test (ISSUE 17 "honest scale"):
+
+- ``(seed, Trace) -> schedule`` is a pure function — byte-identical on
+  replay, asserted through :func:`schedule_fingerprint`;
+- arrival processes (poisson thinning, pareto gaps), trace shapes
+  (constant/diurnal/spike), tenant mixes, and open-loop multi-turn
+  sessions (turn k at ``t0 + k*think_s``, never gated on replies);
+- virtual time: :class:`EventQueue` makes 10^5 virtual users cost heap
+  events, not threads;
+- THE coordinated-omission demonstration: the same schedule through the
+  open-loop reference simulator vs the closed-loop one over a scripted
+  10 s server stall — the open loop's arrival-time p99 shows the stall,
+  the closed loop's send-time p99 hides it (Tene; Schroeder NSDI'06).
+
+Pure python — no jax, no servers — so the whole file runs anywhere.
+"""
+import heapq
+import random
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.observability.metrics import nearest_rank
+from mmlspark_tpu.testing import loadgen
+from mmlspark_tpu.testing.loadgen import (
+    Arrival, EventQueue, PromptPopulation, Trace, bucket_counts,
+    feature_rows, generate, peak_rate, rate_at, run_open_loop,
+    schedule_fingerprint, simulate_closed_loop, simulate_open_loop,
+    token_prompts)
+
+
+# ---------------------------------------------------------------- replay
+def test_same_seed_and_trace_replays_byte_identical():
+    trace = Trace(duration_s=30.0, rate=5.0, shape="spike",
+                  spike_start_s=10.0, spike_len_s=5.0, spike_factor=4.0)
+    a = generate(trace, 7)
+    b = generate(trace, 7)
+    assert a == b
+    assert schedule_fingerprint(a) == schedule_fingerprint(b)
+
+
+def test_different_seed_changes_fingerprint():
+    trace = Trace(duration_s=10.0, rate=8.0)
+    assert schedule_fingerprint(generate(trace, 1)) != \
+        schedule_fingerprint(generate(trace, 2))
+
+
+def test_schedule_is_time_sorted_with_positional_index():
+    sched = generate(Trace(duration_s=20.0, rate=10.0), 3)
+    assert sched
+    assert all(a.t <= b.t for a, b in zip(sched, sched[1:]))
+    assert [a.index for a in sched] == list(range(len(sched)))
+    assert all(0.0 <= a.t < 20.0 for a in sched)
+
+
+# ---------------------------------------------------------------- shapes
+def test_spike_shape_concentrates_arrivals_in_the_window():
+    trace = Trace(duration_s=60.0, rate=2.0, shape="spike",
+                  spike_start_s=20.0, spike_len_s=10.0, spike_factor=10.0)
+    sched = generate(trace, 0)
+    inside = sum(1 for a in sched if 20.0 <= a.t < 30.0)
+    outside = len(sched) - inside
+    # 10 s at 20/s vs 50 s at 2/s: the window should dominate per-second
+    assert inside / 10.0 > 3 * (outside / 50.0)
+    assert rate_at(trace, 25.0) == 20.0
+    assert rate_at(trace, 5.0) == 2.0
+    assert peak_rate(trace) == 20.0
+
+
+def test_diurnal_rate_swings_within_the_envelope():
+    trace = Trace(duration_s=100.0, rate=10.0, shape="diurnal",
+                  diurnal_amplitude=0.5)
+    rates = [rate_at(trace, t) for t in np.linspace(0, 100, 200)]
+    assert min(rates) < 10.0 < max(rates)
+    assert max(rates) <= peak_rate(trace) + 1e-9
+    assert all(r >= 0.0 for r in rates)
+
+
+def test_unknown_shape_and_process_raise():
+    with pytest.raises(ValueError):
+        rate_at(Trace(duration_s=1.0, rate=1.0, shape="sawtooth"), 0.0)
+    with pytest.raises(ValueError):
+        generate(Trace(duration_s=1.0, rate=1.0, process="uniform"), 0)
+
+
+def test_pareto_process_generates_and_requires_finite_mean():
+    sched = generate(Trace(duration_s=50.0, rate=4.0, process="pareto",
+                           pareto_alpha=1.5), 0)
+    assert sched and all(0.0 <= a.t < 50.0 for a in sched)
+    with pytest.raises(ValueError):
+        generate(Trace(duration_s=1.0, rate=1.0, process="pareto",
+                       pareto_alpha=1.0), 0)
+
+
+# ------------------------------------------------------- tenants/sessions
+def test_tenant_mix_draws_both_tenants():
+    trace = Trace(duration_s=60.0, rate=10.0,
+                  tenants=(("free", 1.0), ("paid", 3.0)))
+    sched = generate(trace, 5)
+    by = {}
+    for a in sched:
+        by[a.tenant] = by.get(a.tenant, 0) + 1
+    assert set(by) == {"free", "paid"}
+    assert by["paid"] > by["free"]          # 3:1 weighting
+
+
+def test_sessions_schedule_turns_at_think_intervals_open_loop():
+    trace = Trace(duration_s=30.0, rate=2.0, session_turns=4, think_s=3.0)
+    sched = generate(trace, 11)
+    by_sess = {}
+    for a in sched:
+        assert a.session
+        by_sess.setdefault(a.session, []).append(a)
+    multi = [v for v in by_sess.values() if len(v) > 1]
+    assert multi, "seeded trace should include multi-turn sessions"
+    for turns in by_sess.values():
+        turns.sort(key=lambda a: a.turn)
+        t0 = turns[0].t
+        for a in turns:
+            # turn k lands at exactly t0 + k*think_s: scheduled from the
+            # session's intent, never from the previous reply
+            assert a.t == pytest.approx(t0 + a.turn * 3.0)
+            assert a.trace_id == f"{a.session}.t{a.turn}"
+
+
+def test_singleton_arrival_trace_id_is_indexed():
+    a = Arrival(t=0.5, index=7)
+    assert a.trace_id == "q000007"
+
+
+# ---------------------------------------------------------------- buckets
+def test_bucket_counts_partition_the_schedule():
+    sched = generate(Trace(duration_s=90.0, rate=3.0), 2)
+    counts = bucket_counts(sched, 30.0)
+    assert sum(counts) == len(sched)
+    assert len(counts) == 3
+    # min_buckets pads with empty rounds; 0 bucket size is an error
+    assert len(bucket_counts(sched, 30.0, min_buckets=6)) == 6
+    with pytest.raises(ValueError):
+        bucket_counts(sched, 0.0)
+
+
+# ------------------------------------------------------------ populations
+def test_feature_rows_byte_identical_to_the_seeded_generator():
+    got = feature_rows(4, 2, 8, 13)
+    rng = np.random.default_rng(13)
+    want = [rng.normal(0, 1, (2, 8)).astype(np.float32) for _ in range(4)]
+    assert all(np.array_equal(g, w) for g, w in zip(got, want))
+    assert all(g.dtype == np.float32 for g in got)
+
+
+def test_token_prompts_deterministic_on_the_callers_stream():
+    a = token_prompts(6, random.Random(5))
+    b = token_prompts(6, random.Random(5))
+    assert a == b
+    assert all(3 <= len(p) <= 8 for p in a)
+    assert all(1 <= t < 200 for p in a for t in p)
+
+
+def test_prompt_population_shares_prefixes_zipf_weighted():
+    pop = PromptPopulation(random.Random(3), prefixes=4, prefix_tokens=6,
+                           zipf_s=1.1)
+    p0 = pop.prefix(0)
+    assert len(p0) == 6
+    hits = {i: 0 for i in range(4)}
+    for _ in range(400):
+        s = pop.sample(tail_tokens=2)
+        assert len(s) == 8
+        for rank in range(4):
+            if s[:6] == pop.prefix(rank):
+                hits[rank] += 1
+                break
+    assert sum(hits.values()) == 400          # every sample reuses a prefix
+    assert hits[0] == max(hits.values())      # rank 0 is hottest
+
+
+# ------------------------------------------------------------ event queue
+def test_event_queue_orders_by_time_fifo_on_ties():
+    q = EventQueue()
+    seen = []
+    q.push(2.0, lambda t: seen.append("late"))
+    q.push(1.0, lambda t: seen.append("a"))
+    q.push(1.0, lambda t: seen.append("b"))
+    assert q.run(until=1.5) == 2
+    assert seen == ["a", "b"] and q.now == 1.0
+    q.run()
+    assert seen == ["a", "b", "late"] and q.now == 2.0
+
+
+def test_event_queue_scales_to_1e5_virtual_users():
+    # the whole point of virtual time: 10^5 users are heap events
+    q = EventQueue()
+    hits = [0]
+
+    def bump(t):
+        hits[0] += 1
+
+    for i in range(100_000):
+        q.push((i * 37) % 1000 / 10.0, bump)
+    assert q.run() == 100_000
+    assert hits[0] == 100_000
+
+
+# --------------------------------------------- coordinated omission (the
+# satellite-3 demonstration: same schedule, 10 s stall, two drivers)
+def test_open_loop_sees_the_stall_closed_loop_hides_it():
+    trace = Trace(duration_s=60.0, rate=5.0)
+    sched = generate(trace, 4)
+    stall = (20.0, 30.0)                      # server wedged for 10 s
+
+    open_res = simulate_open_loop(sched, 0.01, stalls=[stall])
+    # one closed-loop client: exactly ONE request (the in-flight one) ever
+    # observes the stall — every arrival behind it just isn't sent, so
+    # the ~50 samples the outage should have produced never exist
+    closed_res = simulate_closed_loop(sched, 0.01, stalls=[stall],
+                                      clients=1)
+    assert len(open_res) == len(closed_res) == len(sched)
+
+    open_p99 = nearest_rank(
+        sorted(r["latency_s"] for r in open_res), 99)
+    closed_p99 = nearest_rank(
+        sorted(r["latency_s"] for r in closed_res), 99)
+    # open loop: arrivals during the stall queue from their INTENDED
+    # time, so the p99 carries seconds of the 10 s outage
+    assert open_p99 > 5.0
+    # closed loop over the SAME schedule and SAME stall: clients simply
+    # stopped sending, so the send-time p99 stays pretty — the lie
+    assert closed_p99 < 1.0
+    assert open_p99 > 10 * closed_p99
+
+
+def test_open_loop_simulator_latency_runs_from_intended_arrival():
+    sched = [Arrival(t=0.0, index=0), Arrival(t=0.1, index=1)]
+    res = simulate_open_loop(sched, 1.0)
+    # second request waits for the first's full service: latency from
+    # its own arrival is (1.0 - 0.1) queueing + 1.0 service
+    assert res[1]["latency_s"] == pytest.approx(1.9)
+
+
+# ------------------------------------------------------------- wall pacer
+def test_run_open_loop_paces_to_intended_times_with_injected_clock():
+    sched = generate(Trace(duration_s=2.0, rate=5.0), 8)
+    clock = {"t": 100.0}
+    slept = []
+    sent = []
+
+    def fake_clock():
+        return clock["t"]
+
+    def fake_sleep(dt):
+        slept.append(dt)
+        clock["t"] += dt
+
+    t0 = run_open_loop(sched, lambda a: sent.append((a.trace_id,
+                                                     clock["t"])),
+                       clock=fake_clock, sleep=fake_sleep)
+    assert t0 == 100.0
+    assert [s[0] for s in sent] == [a.trace_id for a in sched]
+    for (tid, t_sent), a in zip(sent, sched):
+        assert t_sent == pytest.approx(100.0 + a.t)
+    assert all(dt > 0 for dt in slept)

@@ -598,6 +598,93 @@ def test_gate_prefix_and_spec_rates_informational_never_red():
     assert info["spec_accept_rate"]["fresh"] == 0.1
 
 
+def test_percentile_from_buckets_ex_reports_overflow_clip():
+    # rank lands inside a finite bucket: interpolated, not clipped
+    cum = {"0.1": 50, "0.5": 90, "+Inf": 100}
+    v, clipped = obsmetrics.percentile_from_buckets_ex(cum, 50)
+    assert 0.0 < v <= 0.5 and clipped is False
+    assert v == obsmetrics.percentile_from_buckets(cum, 50)
+    # rank in the +Inf overflow: the highest finite bound is a FLOOR
+    v, clipped = obsmetrics.percentile_from_buckets_ex(cum, 99)
+    assert v == 0.5 and clipped is True
+    # empty histogram: zero, and honestly not clipped
+    assert obsmetrics.percentile_from_buckets_ex({}, 99) == (0.0, False)
+
+
+def test_clipped_predicate_exact_deadline_equality_only():
+    from mmlspark_tpu.observability.benchgate import clipped
+    lane = {"spike_p99_ms": 90000.0, "deadline_ms": 90000.0}
+    assert clipped(lane, "spike_p99_ms") is True
+    # an honest open-loop measurement ABOVE the deadline is a real (bad)
+    # number, not a clip — gating it is the whole point
+    assert clipped({"arrival_p99_ms": 210000.0, "deadline_ms": 90000.0},
+                   "arrival_p99_ms") is False
+    assert clipped({"arrival_p99_ms": 100.0, "deadline_ms": 90000.0},
+                   "arrival_p99_ms") is False
+    # the explicit flag wins even without a deadline field
+    assert clipped({"ttft_p99_ms": 5.0, "ttft_p99_ms_clipped": True},
+                   "ttft_p99_ms") is True
+    assert clipped({"spike_p99_ms": 100.0}, "spike_p99_ms") is False
+
+
+def test_gate_fresh_clipped_against_unclipped_baseline_is_red():
+    base = _line(ap=dict(_lane(), spike_p99_ms=40000.0,
+                         deadline_ms=90000.0))
+    fresh = _line(ap=dict(_lane(), spike_p99_ms=90000.0,
+                          deadline_ms=90000.0))
+    v = compare(fresh, base)
+    assert v["red"] == ["ap"]
+    assert any("clipped at the deadline" in r
+               for r in v["lanes"]["ap"]["reasons"])
+
+
+def test_gate_clipped_vs_clipped_is_never_parity_evidence():
+    # the r08 blind spot: 90000 vs 90000 proves nothing — the check is
+    # demoted to informational with the refusal spelled out
+    lane = dict(_lane(), spike_p99_ms=90000.0, deadline_ms=90000.0)
+    v = compare(_line(ap=dict(lane)), _line(ap=dict(lane)))
+    assert v["green"] is True
+    c = {c["metric"]: c for c in v["lanes"]["ap"]["checks"]}
+    sp = c["spike_p99_ms"]
+    assert sp["informational"] is True
+    assert sp["clipped"] is True and sp["baseline_clipped"] is True
+    assert "not parity evidence" in sp["note"]
+
+
+def test_gate_legacy_closed_loop_baseline_is_informational():
+    # an r08-era lane: spike_p99_ms but no deadline_ms/arrival_p99_ms —
+    # its latency cannot even be tested for clipping, so the transition
+    # to the open-loop driver can never false-fail against it
+    base = _line(ap=dict(_lane(), spike_p99_ms=90000.0))
+    fresh = _line(ap=dict(_lane(), spike_p99_ms=170000.0,
+                          deadline_ms=90000.0, arrival_p99_ms=170000.0))
+    v = compare(fresh, base)
+    assert v["green"] is True
+    c = {c["metric"]: c for c in v["lanes"]["ap"]["checks"]}
+    assert c["spike_p99_ms"]["informational"] is True
+    assert "legacy closed-loop" in c["spike_p99_ms"]["note"]
+
+
+def test_gate_goodput_and_arrival_p99_are_gated_fields():
+    base = _line(sv=dict(_lane(), goodput=0.95, arrival_p99_ms=100.0,
+                         deadline_ms=250.0))
+    # goodput is higher-is-better
+    v = compare(_line(sv=dict(_lane(), goodput=0.5, arrival_p99_ms=100.0,
+                              deadline_ms=250.0)), base)
+    assert v["red"] == ["sv"]
+    assert any("goodput" in r for r in v["lanes"]["sv"]["reasons"])
+    # arrival_p99_ms is lower-is-better, un-clipped values gate normally
+    v = compare(_line(sv=dict(_lane(), goodput=0.95,
+                              arrival_p99_ms=200.0, deadline_ms=250.0)),
+                base)
+    assert v["red"] == ["sv"]
+    assert any("arrival_p99_ms" in r for r in v["lanes"]["sv"]["reasons"])
+    # improvements on both axes stay green
+    v = compare(_line(sv=dict(_lane(), goodput=0.99, arrival_p99_ms=50.0,
+                              deadline_ms=250.0)), base)
+    assert v["green"] is True
+
+
 def test_load_baseline_accepts_wrapper_and_raw_forms(tmp_path):
     raw = _line(train=_lane())
     p_raw = tmp_path / "raw.json"

@@ -729,3 +729,72 @@ def test_process_rule_launcher_home_exempt():
         src, filename="mmlspark_tpu/serve/router.py")
     assert len(probs) == 1 and "process management" in probs[0]
     assert "serve/launcher.py" in probs[0]      # named as a home now
+
+
+# -- Rule 16: chaos load comes from testing/loadgen ---------------------------
+
+def test_handload_rule_flags_private_rng_in_chaos():
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def scenario():
+            rng = np.random.default_rng(0)
+            return rng
+    """)
+    probs = lint.check_source(
+        src, filename="mmlspark_tpu/reliability/chaos.py")
+    assert len(probs) == 1
+    assert "hand-rolled load" in probs[0]
+    assert "testing/loadgen.py" in probs[0]     # the sanctioned home
+    assert "allow-handload" in probs[0]         # the escape hatch is named
+
+
+def test_handload_rule_flags_draws_inside_comprehensions():
+    src = textwrap.dedent("""
+        def scenario(rng, n):
+            lens = [rng.randint(4, 8) for _ in range(n)]
+            more = {rng.randrange(3) for _ in range(n)}
+            return lens, more
+    """)
+    probs = lint.check_source(
+        src, filename="mmlspark_tpu/reliability/chaos.py")
+    assert len(probs) == 2
+    assert all("comprehension" in p for p in probs)
+
+
+def test_handload_rule_statement_level_draws_are_fine():
+    # a single scenario parameter (one kill index, one jitter) is not a
+    # payload stream; only comprehension-built streams are flagged
+    src = textwrap.dedent("""
+        def scenario(rng, n):
+            kill_at = rng.randint(0, n)
+            return kill_at
+    """)
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/reliability/chaos.py") == []
+
+
+def test_handload_rule_marker_and_other_files_exempt():
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def scenario(rng, n):
+            priv = np.random.default_rng(0)  # lint: allow-handload
+            lens = [rng.randint(4, 8) for _ in range(n)]  # lint: allow-handload
+            return priv, lens
+    """)
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/reliability/chaos.py") == []
+    # the rule is scoped to chaos: loadgen itself (and everyone else)
+    # builds streams however it likes
+    unmarked = textwrap.dedent("""
+        import numpy as np
+
+        def build(rng, n):
+            priv = np.random.default_rng(0)
+            return [rng.randint(4, 8) for _ in range(n)]
+    """)
+    assert lint.check_source(
+        unmarked, filename="mmlspark_tpu/testing/loadgen.py") == []
+    assert lint.check_source(
+        unmarked, filename="mmlspark_tpu/serve/router.py") == []
