@@ -765,9 +765,14 @@ def cmd_chaos(args, passthrough) -> int:
     half-spawned slot completes registration or is cleanly reaped (no
     zombie in the router rotation), desired == live after
     reconciliation, and the warm scale-up pays zero XLA compiles.
+    ``--scenario recommender``: kill a replica mid-scoring with
+    row-sharded embedding tables resident; zero failed requests,
+    scores bit-identical to an unsharded single server, and the HBM
+    ledger's kind="table" lines reconcile to zero on close.
     Writes ``chaos_verdict.json`` under --out; exit 0 iff every
     invariant held."""
-    if args.scenario.endswith("_sharded") and "jax" not in sys.modules:
+    if (args.scenario.endswith("_sharded")
+            or args.scenario == "recommender") and "jax" not in sys.modules:
         # the 2-D mesh needs >= 4 devices: raise the host-platform count
         # BEFORE jax first loads so a CPU-only host can form it (same
         # seam as bench.py's xl lanes; on accelerator hosts the flag
@@ -808,6 +813,10 @@ def cmd_chaos(args, passthrough) -> int:
             args.seed, outdir, replicas=args.replicas)
     elif args.scenario == "elastic":
         verdict = chaos.run_elastic_scenario(
+            args.seed, outdir, replicas=args.replicas,
+            requests=args.requests)
+    elif args.scenario == "recommender":
+        verdict = chaos.run_recommender_scenario(
             args.seed, outdir, replicas=args.replicas,
             requests=args.requests)
     else:
@@ -1035,7 +1044,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "static fleet vs autopiloted fleet; "
                          "elastic: SIGKILL a worker mid autopilot-driven "
                          "supervised scale-up — no zombie slot, desired "
-                         "== live after reconciliation "
+                         "== live after reconciliation; "
+                         "recommender: kill a replica mid-scoring with "
+                         "row-sharded embedding tables resident — "
+                         "bit-identical scores, ledger reconciles "
                          "(default: train; unknown scenarios list the "
                          "registry and exit 2)")
     chaos_p.add_argument("--seed", type=int, default=0,
@@ -1051,9 +1063,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     chaos_p.add_argument("--requests", type=int, default=12,
                          help="serve-phase request count (default 12)")
     chaos_p.add_argument("--replicas", type=int, default=3,
-                         help="fleet width for --scenario fleet/decode; "
-                         "worker-process count for --scenario "
-                         "host/elastic (default 3)")
+                         help="fleet width for --scenario "
+                         "fleet/decode/recommender; worker-process count "
+                         "for --scenario host/elastic (default 3)")
     chaos_p.set_defaults(fn=cmd_chaos)
 
     report_p = sub.add_parser(

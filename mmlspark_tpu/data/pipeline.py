@@ -17,7 +17,10 @@ materializing a whole corpus into a host ``Frame`` before training
   ``data.decode_dropped`` metric;
 - :class:`Batcher` — fixed-size host batches with ``drop``/``pad``/
   ``keep`` remainder policies (``pad`` zero-fills and masks via a
-  ``weight`` column — ``DistributedTrainer``'s pad-and-mask contract);
+  ``weight`` column — ``DistributedTrainer``'s pad-and-mask contract),
+  plus a ``multi_hot`` pad policy for RAGGED id-list columns (recommender
+  sparse features): each record's variable-length id list pads/truncates
+  to a fixed slot width with pad id 0 and a per-slot weight mask;
 - :meth:`Dataset.to_device_iterator` — the terminal stage: the same
   :class:`~mmlspark_tpu.data.prefetch.DevicePrefetcher` the trainer uses.
 
@@ -124,8 +127,9 @@ class Dataset:
     def map(self, fn: Callable[[Any], Any]) -> "MapRecords":
         return MapRecords(self, fn)
 
-    def batch(self, size: int, remainder: str = "drop") -> "Batcher":
-        return Batcher(self, size, remainder=remainder)
+    def batch(self, size: int, remainder: str = "drop",
+              multi_hot: Optional[Dict[str, int]] = None) -> "Batcher":
+        return Batcher(self, size, remainder=remainder, multi_hot=multi_hot)
 
     def repeat(self, epochs: Optional[int] = None) -> "Repeat":
         return Repeat(self, epochs=epochs)
@@ -506,23 +510,65 @@ class Batcher(Dataset):
 
     Numeric record fields stack (shapes must agree — resize images first
     via ``map``); strings/bytes/objects become object columns.
+
+    ``multi_hot`` maps RAGGED id-list columns to a fixed slot width (the
+    recommender's sparse-feature wire contract): each record's
+    variable-length id sequence pads to ``slots`` with
+    ``MULTI_HOT_PAD_ID`` (truncating overflow deterministically from the
+    front-kept side) and gains a float32 ``<col>_weight`` mask column
+    (1.0 real slot / 0.0 pad), so downstream embedding bag lookups see
+    static shapes and zero-weighted pads — the same pad-and-mask
+    convention ``embed.tables`` reserves row 0 for. The transform is
+    stateless, so snapshot/resume bit-identity is untouched.
     """
 
     REMAINDERS = ("drop", "pad", "keep")
 
-    def __init__(self, upstream: Dataset, size: int, remainder: str = "drop"):
+    def __init__(self, upstream: Dataset, size: int, remainder: str = "drop",
+                 multi_hot: Optional[Dict[str, int]] = None):
         if size < 1:
             raise ValueError(f"batch size must be >= 1, got {size}")
         if remainder not in self.REMAINDERS:
             raise ValueError(f"remainder must be one of {self.REMAINDERS}, "
                              f"got {remainder!r}")
+        if multi_hot:
+            for col, slots in multi_hot.items():
+                if int(slots) < 1:
+                    raise ValueError(
+                        f"multi_hot slots must be >= 1, got {col}={slots}")
         self.upstream = upstream
         self.size = size
         self.remainder = remainder
+        self.multi_hot = dict(multi_hot or {})
 
     def iter(self, epoch: int = 0) -> PipelineIterator:
         return _BatchIter(self.upstream.iter(epoch), self.size,
-                          self.remainder)
+                          self.remainder, self.multi_hot)
+
+
+# pad slot id for multi-hot columns; matches embed.tables.PAD_ID (row 0
+# of every embedding table is the reserved all-zero pad row)
+MULTI_HOT_PAD_ID = 0
+
+
+def _pad_multi_hot(rows: List[Record],
+                   multi_hot: Dict[str, int]) -> List[Record]:
+    """Normalize ragged id-list columns to fixed ``(slots,)`` int32 rows
+    plus per-slot weight masks. Pure per-record transform — no state, so
+    the batch boundary snapshot stays the only resume cursor."""
+    out: List[Record] = []
+    for r in rows:
+        r = dict(r)
+        for col, slots in multi_hot.items():
+            ids = np.asarray(r.get(col, ()), np.int64).reshape(-1)[:slots]
+            padded = np.full(slots, MULTI_HOT_PAD_ID, np.int32)
+            padded[:ids.size] = ids
+            mask = np.zeros(slots, np.float32)
+            mask[:ids.size] = 1.0
+            r[col] = padded
+            r[f"{col}_weight"] = mask
+        out.append(r)
+    return out
 
 
 def _stack_records(rows: List[Record], pad_to: Optional[int] = None
@@ -561,10 +607,12 @@ def _stack_records(rows: List[Record], pad_to: Optional[int] = None
 
 
 class _BatchIter(PipelineIterator):
-    def __init__(self, up: PipelineIterator, size: int, remainder: str):
+    def __init__(self, up: PipelineIterator, size: int, remainder: str,
+                 multi_hot: Optional[Dict[str, int]] = None):
         self._up = up
         self._size = size
         self._remainder = remainder
+        self._multi_hot = dict(multi_hot or {})
         self._boundary = up.state_dict()  # upstream state at last batch edge
 
     def __next__(self) -> Dict[str, np.ndarray]:
@@ -576,6 +624,8 @@ class _BatchIter(PipelineIterator):
                 break
         if not rows:
             raise StopIteration
+        if self._multi_hot:
+            rows = _pad_multi_hot(rows, self._multi_hot)
         if len(rows) < self._size:
             if self._remainder == "drop":
                 raise StopIteration

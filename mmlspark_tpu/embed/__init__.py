@@ -1,0 +1,18 @@
+"""Sharded-embedding recommender subsystem (docs/RECOMMENDER.md).
+
+Model-parallel embedding tables deliberately too large for one chip:
+rows shard over the ``tensor`` mesh axis, lookups run as one fused
+``shard_map`` program (bucketize ids per shard -> all-to-all the
+requests -> local gather -> all-to-all the rows back -> segment-sum the
+multi-hot bags), and the sparse-gradient path scatter-adds straight
+into each chip's row shard. ``model.py`` wraps the tables in a
+DLRM-lite two-tower module registered in the model zoo, so the same
+tables back ``DistributedTrainer.fit`` training AND online fleet
+scoring through ``serve/``.
+"""
+from mmlspark_tpu.embed.tables import (EmbeddingCollection, EmbeddingTable,
+                                       bag_lookup_reference,
+                                       make_bag_lookup, sparse_table_grads)
+
+__all__ = ["EmbeddingCollection", "EmbeddingTable", "bag_lookup_reference",
+           "make_bag_lookup", "sparse_table_grads"]

@@ -1,0 +1,112 @@
+"""DLRM-lite recommender: sparse embedding bags + dense towers + dot
+interaction, as one zoo architecture (``recommender_dlrm``).
+
+The wire format is ONE packed float32 row per example —
+``[dense features | slots ids per sparse feature ...]`` — chosen so the
+recommender rides the ENTIRE existing serving stack unchanged: the
+micro-batcher coalesces packed rows like any tabular input, the
+registry AOT-compiles one program per batch bucket, and the router
+fails over without knowing tables exist. Ids travel as float32 (exact
+up to 2^24 — far beyond any table this repo can hold) and are cast
+back to int32 on device; slot id 0 is the pad, its weight is 0.
+
+The embedding params are named ``<feature>_embedding``, which lands
+them on ``parallel/sharding.py``'s ``.*embedding$`` rule: under any
+tensor-axis mesh — ``DistributedTrainer``'s or a serving
+``meshSpec`` — the tables are row-sharded with NO recommender-specific
+plumbing anywhere in trainer, checkpointer, or registry. Training can
+inject the fused all-to-all lookup (``lookup_fn=make_bag_lookup(mesh)``)
+for the explicit bucketized path + scatter-add sparse gradient;
+serving keeps the default gather (GSPMD partitions it against the
+sharded table) so the architecture stays serializable by name.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from mmlspark_tpu.embed.tables import PAD_ID, bag_lookup_reference
+from mmlspark_tpu.models.zoo import register_model
+from mmlspark_tpu.utils import config as mmlconfig
+
+
+class DLRM(nn.Module):
+    """Two-tower DLRM-lite over packed rows (see module docstring)."""
+    dense_dim: int
+    tables: Tuple[Tuple[str, int], ...]    # ((name, rows), ...) in slot order
+    embed_dim: int = 16
+    slots: int = 4
+    bottom: Tuple[int, ...] = (32,)
+    top: Tuple[int, ...] = (32,)
+    num_classes: int = 1
+    lookup_fn: Optional[Callable] = None   # None = reference gather (GSPMD)
+
+    @nn.compact
+    def __call__(self, x):
+        dense = x[:, :self.dense_dim]
+        h = dense
+        for i, width in enumerate(self.bottom):
+            h = nn.relu(nn.Dense(width, name=f"bottom_fc{i}")(h))
+        feats = [nn.Dense(self.embed_dim, name="bottom_out")(h)]
+        lookup = self.lookup_fn or bag_lookup_reference
+        off = self.dense_dim
+        for name, rows in self.tables:
+            ids = x[:, off:off + self.slots].astype(jnp.int32)
+            off += self.slots
+            weights = (ids != PAD_ID).astype(jnp.float32)
+            table = self.param(
+                f"{name}_embedding",
+                nn.initializers.normal(stddev=self.embed_dim ** -0.5),
+                (rows, self.embed_dim), jnp.float32)
+            feats.append(lookup(table, ids, weights))
+        stack = jnp.stack(feats, axis=1)            # (B, F, D)
+        # dot interaction: pairwise feature affinities, upper triangle
+        dots = jnp.einsum("bfd,bgd->bfg", stack, stack)
+        f = stack.shape[1]
+        iu, ju = np.triu_indices(f, k=1)
+        z = jnp.concatenate([feats[0], dots[:, iu, ju]], axis=1)
+        self.sow("intermediates", "interaction", z)
+        for i, width in enumerate(self.top):
+            z = nn.relu(nn.Dense(width, name=f"top_fc{i}")(z))
+        return nn.Dense(self.num_classes, name="head")(z)
+
+
+def padded_rows(rows: int) -> int:
+    # embed.row_multiple (default 8): the shard granule — any tensor
+    # axis up to it divides every padded table evenly
+    m = int(mmlconfig.get("embed.row_multiple"))
+    return -(-int(rows) // m) * m
+
+
+def pack_rows(dense: np.ndarray, sparse: Sequence[np.ndarray]) -> np.ndarray:
+    """Host-side wire packing: float32 ``[dense | ids...]`` rows. Each
+    sparse block is (B, slots) int ids (0 = pad)."""
+    parts = [np.asarray(dense, np.float32)]
+    parts += [np.asarray(ids, np.float32) for ids in sparse]
+    return np.concatenate(parts, axis=1)
+
+
+@register_model("recommender_dlrm")
+def recommender_dlrm(dense_dim: int = 8,
+                     tables: Any = (("user", 1024), ("item", 2048)),
+                     embed_dim: int = 16, slots: int = 4,
+                     bottom=(32,), top=(32,), num_classes: int = 1,
+                     lookup_fn: Optional[Callable] = None):
+    """Zoo builder. ``tables`` is ``((name, rows), ...)``; rows round up
+    to the shard multiple so any tensor axis divides them. JSON-decoded
+    specs arrive as lists — normalized here so serialized stages
+    rebuild the same module."""
+    tabs = tuple((str(n), padded_rows(r)) for n, r in tables)
+    width = dense_dim + len(tabs) * slots
+    return dict(
+        module=DLRM(dense_dim=dense_dim, tables=tabs, embed_dim=embed_dim,
+                    slots=slots, bottom=tuple(bottom), top=tuple(top),
+                    num_classes=num_classes, lookup_fn=lookup_fn),
+        input_shape=(width,),
+        feature_layer="interaction",
+        feature_dim=None,
+        layer_names=["interaction", "head"],
+    )

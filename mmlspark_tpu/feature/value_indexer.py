@@ -11,6 +11,15 @@ Re-expression of the reference's StringIndexer generalization
   ``ValueIndexer.scala:145-169``).
 - The output column carries the CategoricalMap in its metadata, which is what
   ``IndexToValue`` and the evaluators read back.
+
+:class:`HashIndexer` is the VOCABULARY-FREE sibling for embedding-table
+ids (the recommender path): no fit pass, no level list to ship — any
+categorical value hashes to a stable bucket in ``[1, numBuckets)`` via
+the same Spark-parity murmur3 the text featurizers use, and null/NaN
+map to 0, ``embed.tables.PAD_ID`` — the reserved all-zero pad row whose
+lookup weight is 0. Where ``ValueIndexer`` must see the whole column to
+sort levels (and breaks on unseen values), ``HashIndexer`` indexes
+streams it has never seen, which is what an online scoring path needs.
 """
 from __future__ import annotations
 
@@ -20,7 +29,8 @@ from typing import Any, List
 import numpy as np
 
 from mmlspark_tpu.core.frame import Frame
-from mmlspark_tpu.core.params import HasInputCol, HasOutputCol, ListParam
+from mmlspark_tpu.core.params import (HasInputCol, HasOutputCol, IntParam,
+                                      ListParam)
 from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
 from mmlspark_tpu.core.schema import CategoricalMap, ColumnSchema, DType, SchemaError
 from mmlspark_tpu.core.serialization import register_stage
@@ -84,6 +94,69 @@ class ValueIndexerModel(HasInputCol, HasOutputCol, Model):
             self.outputCol, DType.INT32,
             metadata={"categorical": cmap.to_metadata(),
                       "original_dtype": self._state["input_dtype"]}))
+
+
+@register_stage
+class HashIndexer(HasInputCol, HasOutputCol, Transformer):
+    """Stateless categorical-to-id hashing for embedding tables.
+
+    ``numBuckets`` is the table's row count INCLUDING the reserved pad
+    row: real values land in ``[1, numBuckets)`` (murmur3 of the value's
+    canonical string, Spark seed — stable across processes and restarts,
+    unlike Python's salted ``hash``), null/NaN land on 0 (the pad row,
+    masked to zero weight by the bag lookup). Collisions are the
+    accepted trade for never shipping a vocabulary; size ``numBuckets``
+    to the table, not the cardinality.
+    """
+
+    numBuckets = IntParam(
+        "numBuckets", "embedding-table rows incl. the pad row 0; real "
+        "ids land in [1, numBuckets)", 1 << 16,
+        validator=lambda v: v >= 2)
+
+    def transform(self, frame: Frame) -> Frame:
+        dtype = frame.schema[self.inputCol].dtype
+        if dtype in (DType.VECTOR, DType.IMAGE, DType.BINARY, DType.TOKENS):
+            raise SchemaError(f"unsupported categorical type {dtype.value}")
+        from mmlspark_tpu.ops.hashing import murmur3_batch
+        buckets = int(self.numBuckets)
+
+        def index_part(p):
+            arr = p[self.inputCol]
+            keys, real_pos = [], []
+            out = np.zeros(len(arr), dtype=np.int32)   # nulls stay on pad
+            for i, v in enumerate(arr):
+                if _is_nanlike(v):
+                    continue
+                key = v.item() if isinstance(v, np.generic) else v
+                keys.append(_canonical_str(key))
+                real_pos.append(i)
+            if keys:
+                h = murmur3_batch(keys).astype(np.int64)
+                out[real_pos] = 1 + (h % np.int64(buckets - 1))
+            return out
+
+        return frame.with_column(
+            ColumnSchema(self.outputCol, DType.INT32,
+                         metadata={"hash_buckets": buckets, "pad_id": 0}),
+            index_part)
+
+    def transform_schema(self, schema):
+        return schema.add(ColumnSchema(
+            self.outputCol, DType.INT32,
+            metadata={"hash_buckets": int(self.numBuckets), "pad_id": 0}))
+
+
+def _canonical_str(v: Any) -> str:
+    """One spelling per value across dtypes: ints never pick up a float
+    suffix (``3`` and ``3.0`` hash identically — a column that arrives
+    int64 in training and float64 in serving must agree), bools hash as
+    their ints."""
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return str(v)
 
 
 @register_stage

@@ -18,6 +18,20 @@ one-line JSON result against the committed baseline per lane:
   below it, and ``arrival_p99_ms`` (latency from the INTENDED arrival
   time, un-clipped) must not rise more than the tolerance above it.
 
+Tail-latency percentiles carry two noise guards the ratio gate lacks:
+an absolute resolution floor — a ``*_p99_ms`` check whose rise is
+within ``_MS_RESOLUTION`` (5 ms) passes even past the ratio tolerance,
+because on a single-digit-ms percentile the ratio gate would red on
+sub-millisecond host scheduler jitter no bench host can resolve (the
+check records ``floor_ms`` when the floor is what saved it) — and
+deep-headroom absorption: when BOTH sides of the comparison sit within
+10% of the lane's ``deadline_ms``, the percentile is measuring host
+noise far from the saturation knee, not SLO behaviour (goodput is the
+gated signal there), so the check passes and records ``headroom_ms``.
+A rise that crosses OUT of the headroom band still reds. ``step_ms``
+gets neither guard: it is a mean over many steps, where a 2 ms rise is
+signal, not noise.
+
 Clipped percentiles are never parity evidence. A latency percentile
 that sits exactly at the lane's ``deadline_ms`` — or that the lane
 marks ``<field>_clipped`` — is a FLOOR, not a value: the true
@@ -106,6 +120,23 @@ def _legacy_closed_loop(lane: Dict[str, Any]) -> bool:
             and "arrival_p99_ms" not in lane)
 
 
+# absolute resolution floor for tail-latency percentiles: below this, a
+# difference is host scheduler jitter, not a regression — a 10% ratio
+# gate on an 8 ms p99 would be red over 0.8 ms of noise no measurement
+# on a shared-core bench host can resolve. Applies ONLY to the
+# percentile fields in _LATENCY_FIELDS: step_ms is a mean over many
+# steps, where a 2 ms rise IS signal.
+_MS_RESOLUTION = 5.0
+
+# deep-headroom band for tail-latency percentiles: when both sides of a
+# comparison sit within this fraction of the lane's deadline, the p99
+# is nowhere near the queueing knee and its movement is host noise —
+# goodput (gated) is the SLO signal in that regime. A shared-core
+# bench host can turn an 8 ms p99 into 19 ms between identical runs; a
+# real saturation drift blows past 10% of the deadline immediately.
+_HEADROOM_FRAC = 0.10
+
+
 def _check(name: str, fresh_v: Optional[float], base_v: Optional[float],
            tolerance: float, higher_is_better: bool) -> Optional[Dict[str, Any]]:
     """One metric comparison; None when either side can't be checked
@@ -118,8 +149,13 @@ def _check(name: str, fresh_v: Optional[float], base_v: Optional[float],
         ok = ratio >= 1.0 - tolerance
     else:
         ok = ratio <= 1.0 + tolerance
-    return {"metric": name, "fresh": fresh_v, "baseline": base_v,
-            "ratio": round(ratio, 4), "tolerance": tolerance, "ok": ok}
+    out = {"metric": name, "fresh": fresh_v, "baseline": base_v,
+           "ratio": round(ratio, 4), "tolerance": tolerance, "ok": ok}
+    if (not ok and not higher_is_better and name in _LATENCY_FIELDS
+            and fresh_v - base_v <= _MS_RESOLUTION):
+        out["ok"] = True
+        out["floor_ms"] = _MS_RESOLUTION
+    return out
 
 
 def compare(fresh: Dict[str, Any], baseline: Dict[str, Any],
@@ -185,6 +221,18 @@ def compare(fresh: Dict[str, Any], baseline: Dict[str, Any],
                     c["ok"] = False
                     c["note"] = ("fresh percentile clipped at the "
                                  "deadline; baseline was un-clipped")
+                elif not c["ok"]:
+                    # deep-headroom absorption: both sides far inside
+                    # the deadline — see _HEADROOM_FRAC
+                    d = (_num(fresh_lane, "deadline_ms")
+                         or _num(base_lane, "deadline_ms"))
+                    band = _HEADROOM_FRAC * d if d and d > 0 else None
+                    if (band is not None and c["fresh"] <= band
+                            and c["baseline"] <= band):
+                        c["ok"] = True
+                        c["headroom_ms"] = band
+                        c["note"] = ("deep headroom: both sides within "
+                                     "10% of the deadline")
             checks.append(c)
         # compile_ms / cold_start_ms are INFORMATIONAL: cold-start cost
         # swings with cache state and host load, so the comparison is

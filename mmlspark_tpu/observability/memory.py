@@ -9,7 +9,9 @@ outside this home) and the single place totals are kept:
 
 - :func:`nbytes_of` / :func:`param_bytes` — the shared size arithmetic
   the registry and KV arena delegate to;
-- :class:`MemoryLedger` — bytes by ``{model, kind in params|kv|program}``
+- :class:`MemoryLedger` — bytes by ``{model, kind in
+  params|table|kv|program}`` (``table`` = embedding-table rows, split
+  out by :func:`split_param_shard_bytes`)
   with a process high-watermark, published as ``memory.*`` gauges and
   exported per-``{model,kind}`` as labeled series by the fleet scraper;
 - ``memory.pressure`` events emitted when the registry LRU evicts a
@@ -26,6 +28,7 @@ bypass compiles are not charged).
 """
 from __future__ import annotations
 
+import re
 import threading
 from typing import Any, Dict, Optional, Sequence, Tuple
 
@@ -34,7 +37,12 @@ import numpy as np
 from mmlspark_tpu.observability import events, metrics
 from mmlspark_tpu.utils import config as mmlconfig
 
-KINDS = ("params", "kv", "program")
+KINDS = ("params", "table", "kv", "program")
+
+# embedding-table leaves follow the SAME naming convention the sharding
+# rules key on (parallel/sharding.py's ``.*embedding$``): a param path
+# ending in "embedding" is table rows, everything else is dense weights
+_TABLE_LEAF = re.compile(r".*embedding$")
 
 
 def nbytes_of(shape: Sequence[int], dtype: Any) -> int:
@@ -82,6 +90,29 @@ def param_shard_bytes(params: Any) -> int:
     import jax
     return sum(shard_bytes_of(l)
                for l in jax.tree_util.tree_leaves(params))
+
+
+def split_param_shard_bytes(params: Any) -> Tuple[int, int]:
+    """Per-device resident bytes of a param tree SPLIT into
+    ``(dense_bytes, table_bytes)``: leaves whose '/'-joined path matches
+    the ``.*embedding$`` convention are embedding-table rows (charged to
+    the ledger as ``kind="table"`` — the component that scales with the
+    business, not the architecture), everything else is dense weights
+    (``kind="params"``). The two always sum to
+    :func:`param_shard_bytes`."""
+    if params is None:
+        return 0, 0
+    import jax
+    from mmlspark_tpu.parallel.sharding import _path_str
+    dense = table = 0
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    for path, leaf in flat:
+        b = shard_bytes_of(leaf)
+        if _TABLE_LEAF.match(_path_str(path)):
+            table += b
+        else:
+            dense += b
+    return dense, table
 
 
 class MemoryLedger:
@@ -216,13 +247,20 @@ def audit_device_bytes(ledger: Optional[MemoryLedger] = None
     the ledger. ``unaccounted_bytes`` > 0 means device memory the ledger
     does not know about (leaked intermediates, untracked caches); the
     result is advisory — committed-vs-live can legitimately diverge
-    (donated buffers, as-yet-uncollected garbage)."""
+    (donated buffers, as-yet-uncollected garbage).
+
+    Live arrays are counted at PER-SHARD bytes (``shard_bytes_of``, via
+    the sharding's ``shard_shape``), matching how the ledger charges
+    sharded residents — a row-sharded embedding table counts one chip's
+    rows, not the logical total, so sharded models don't show up as
+    phantom "unaccounted" bytes."""
     ledger = ledger or get_ledger()
     accounted = ledger.total()
     try:
         import jax
-        live = sum(int(getattr(a, "nbytes", 0)) for a in jax.live_arrays())
-        arrays = len(jax.live_arrays())
+        arrs = jax.live_arrays()
+        live = sum(shard_bytes_of(a) for a in arrs)
+        arrays = len(arrs)
     except Exception as e:  # platforms without live_arrays support
         return {"supported": False, "error": f"{type(e).__name__}: {e}",
                 "accounted_bytes": accounted}

@@ -147,6 +147,29 @@ def tensor_axis_size(mesh: Optional[Mesh]) -> int:
     return int(mesh.shape.get("tensor", 1)) if mesh is not None else 1
 
 
+def embedding_table_sharding(mesh: Optional[Mesh]) -> NamedSharding:
+    """Placement for an embedding table (rows, dim): rows over ``tensor``
+    — the model-parallel split that lets a table bigger than one chip's
+    HBM live on the mesh with each chip holding a contiguous row range
+    (the same split DEFAULT_RULES' ``.*embedding$`` rule gives nn.Embed
+    leaves, spelled once for the embed/ subsystem). Replicated when the
+    mesh has no non-trivial ``tensor`` axis."""
+    if tensor_axis_size(mesh) > 1:
+        return NamedSharding(mesh, P("tensor", None))
+    return NamedSharding(mesh, P())
+
+
+def embedding_lookup_specs(mesh: Mesh) -> Tuple[P, P, P]:
+    """``(table, ids, out)`` PartitionSpecs for the embed/ fused-lookup
+    ``shard_map``: table rows over ``tensor``, the id batch over the data
+    axes (replicated over ``tensor`` — every model shard sees every id so
+    it can answer for the rows it owns), bags back over the data axes.
+    Weights share the ids spec. THE one place these specs are written
+    (lint Rule 14); ``embed/tables.py`` imports them."""
+    axes = active_batch_axes(mesh)
+    return P("tensor", None), P(axes, None), P(axes, None)
+
+
 def kv_arena_sharding(mesh: Mesh, heads: int) -> NamedSharding:
     """Placement for a paged KV arena (layers, blocks, block_tokens, heads,
     head_dim): the head axis over ``tensor`` when the model axis is

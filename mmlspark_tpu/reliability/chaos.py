@@ -77,6 +77,11 @@ SCENARIOS: Dict[str, str] = {
                "is reaped (never a zombie), the new worker comes up warm "
                "with zero compiles, and both pilots' event logs replay "
                "byte-identical",
+    "recommender": "kill a replica mid-scoring with row-sharded embedding "
+                   "tables resident; zero failed requests, scores "
+                   "bit-identical to an unsharded single server, and the "
+                   "HBM ledger's kind=\"table\" lines reconcile to zero "
+                   "on close",
 }
 
 # the 2-D topology the *_sharded scenarios run on: tensor=2 model axis,
@@ -559,6 +564,185 @@ def run_fleet_scenario(seed: int, outdir: str, replicas: int = 3,
         from mmlspark_tpu.observability import flightrec
         dumped = flightrec.dump(
             reason=f"chaos.fleet.red.seed{seed}",
+            path=os.path.join(outdir, "chaos_flightrec.jsonl"))
+        if dumped:
+            _LOG.error("chaos: flight recorder dumped to %s", dumped)
+    return verdict
+
+
+def run_recommender_scenario(seed: int, outdir: str, replicas: int = 3,
+                             requests: int = 24) -> Dict[str, Any]:
+    """Kill a replica mid-scoring with SHARDED EMBEDDING TABLES resident.
+
+    The fleet scenario's zero-drop + bit-identity contract, on the
+    recommender subsystem (docs/RECOMMENDER.md): every replica serves a
+    DLRM whose embedding tables are row-sharded over the 2-D
+    ``data x tensor`` mesh (:data:`SHARDED_MESH`), scoring a seeded
+    Zipf-id stream drawn from :func:`loadgen.recommender_rows`. At a
+    seeded point mid-stream one seeded replica dies without drain.
+
+    Invariants (verdict JSON, ``outdir/chaos_verdict.json``):
+
+    - ``zero_failed_requests``   — every request eventually scored
+      through the client :class:`RetryPolicy`;
+    - ``scores_bit_identical``   — fleet results == an UNSHARDED
+      single-device single-server reference, row for row, through the
+      kill (the sharded-lookup numerics contract, under failover);
+    - ``failover_observed``      — the kill forced >= 1 failover;
+    - ``tables_charged_per_shard`` — while the fleet serves, the HBM
+      ledger carries the model's ``kind="table"`` bytes at PER-SHARD
+      size (tensor axis = 2 -> half the logical table bytes);
+    - ``ledger_reconciles_on_close`` — after the fleet (and the
+      reference server before it) closes, NO ``{model, kind}`` line
+      survives: dead replicas' table shards must not leak in the fleet
+      HBM view;
+    - ``replicas_stay_probed``   — every probe round answers for every
+      replica;
+    - ``no_unhandled_exceptions``.
+
+    The schedule (kill point, victim, failover count) is a pure function
+    of ``seed`` — the tier-1 smoke test asserts byte-identical replay.
+    """
+    import numpy as np
+
+    from mmlspark_tpu.embed.model import padded_rows
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.observability import memory as devmem
+    from mmlspark_tpu.reliability.retry import RetryPolicy
+    from mmlspark_tpu.serve.fleet import Fleet
+    from mmlspark_tpu.serve.server import Server
+
+    os.makedirs(outdir, exist_ok=True)
+    errors: List[str] = []
+    dense_dim, slots, embed_dim = 8, 4, 8
+    tables = (("user", 64), ("item", 128))
+    verdict: Dict[str, Any] = {
+        "seed": seed, "scenario": "recommender", "replicas": replicas,
+        "requests": requests, "mesh": SHARDED_MESH,
+        "tables": [list(t) for t in tables]}
+
+    rng = random.Random(seed ^ 0x7AB1E5)
+    # kill right after a probe round (see run_fleet_scenario: the WRR
+    # walk then discovers the death by failover, for every seed)
+    probe_every = max(4, replicas + 1)
+    kill_at = -(-rng.randint(requests // 3, (2 * requests) // 3)
+                // probe_every) * probe_every
+    kill_at = min(kill_at, max(requests - probe_every, 0))
+    kill_idx = rng.randrange(replicas)
+
+    model_kw = dict(seed=seed & 0xFFFF, dense_dim=dense_dim,
+                    tables=[list(t) for t in tables],
+                    embed_dim=embed_dim, slots=slots,
+                    bottom=[16], top=[16])
+    stream = loadgen.recommender_rows(
+        requests, dense=dense_dim,
+        tables=tuple((rows, slots) for _, rows in tables), seed=seed)
+
+    ledger = devmem.get_ledger()
+    ledger.reset()
+    # per-chip table residency the ledger must carry while serving:
+    # padded rows x dim x 4 B, halved by the tensor=2 row-sharding
+    expected_shard = sum(padded_rows(rows) * embed_dim * 4
+                         for _, rows in tables) // 2
+
+    # phase 1: UNSHARDED single-server reference — the numerics ground
+    # truth the sharded fleet must match bit-for-bit
+    ref_model = JaxModel(inputCol="x", outputCol="y", miniBatchSize=8)
+    ref_model.set_model("recommender_dlrm", **model_kw)
+    ref_server = Server({"rec": ref_model}, max_batch=4, queue_depth=32)
+    try:
+        reference = [np.asarray(ref_server.submit("rec", x, timeout=30))
+                     for x in stream]
+    finally:
+        ref_server.close()
+    ledger_after_ref = int(ledger.total())
+
+    # phase 2: the same stream through the sharded fleet with a seeded
+    # mid-stream kill; sequential submits keep the WRR walk deterministic
+    model = JaxModel(inputCol="x", outputCol="y", miniBatchSize=8,
+                     meshSpec=SHARDED_MESH)
+    model.set_model("recommender_dlrm", **model_kw)
+    fleet = Fleet({"rec": model}, replicas=replicas,
+                  server_kwargs={"max_batch": 4, "queue_depth": 32})
+    route_log: List[str] = []
+    fleet.router.route_log = route_log
+    client_retry = RetryPolicy(max_attempts=4, base_delay=0.0, jitter=0.0,
+                               name="chaos.recommender.client", seed=seed)
+    results: List[Optional[Any]] = []
+    failed = 0
+    probe_rounds: List[Dict[str, str]] = []
+    table_line_mid = 0
+    try:
+        for i, x in enumerate(stream):
+            if i % probe_every == 0:
+                probe_rounds.append(fleet.router.probe())
+            if i == kill_at:
+                fleet.kill(kill_idx)  # lint: allow-actuate
+            try:
+                results.append(np.asarray(
+                    client_retry.call(fleet.submit, "rec", x)))
+            except Exception as e:
+                failed += 1
+                results.append(None)
+                errors.append(f"request {i}: {type(e).__name__}: {e}")
+        probe_rounds.append(fleet.router.probe())
+        # survivors have re-mirrored their residency since the kill:
+        # the model's table line sits at per-shard bytes, not logical
+        table_line_mid = int(ledger.total(model="rec", kind="table"))
+        stats = fleet.stats()
+    finally:
+        fleet.close()
+    ledger_after_close = int(ledger.total())
+    table_after_close = int(ledger.total(kind="table"))
+
+    identical = all(
+        r is not None and np.array_equal(r, ref)
+        for r, ref in zip(results, reference))
+    probed_ok = bool(probe_rounds) and all(
+        len(round_) == replicas for round_ in probe_rounds)
+    failovers = int(stats["failovers"])
+
+    verdict["schedule"] = {
+        "kill_at": kill_at, "kill_replica": f"r{kill_idx}",
+        "route_log": route_log, "failovers": failovers,
+    }
+    verdict["fleet"] = {
+        "served": sum(1 for r in results if r is not None),
+        "failed": failed, "probe_rounds": len(probe_rounds),
+    }
+    verdict["ledger"] = {
+        "table_bytes_serving": table_line_mid,
+        "expected_shard_bytes": expected_shard,
+        "after_reference_close": ledger_after_ref,
+        "table_bytes_after_close": table_after_close,
+        "total_bytes_after_close": ledger_after_close,
+    }
+    invariants = {
+        "zero_failed_requests": failed == 0,
+        "scores_bit_identical": identical,
+        "failover_observed": failovers >= 1,
+        "tables_charged_per_shard": table_line_mid == expected_shard,
+        "ledger_reconciles_on_close": (ledger_after_ref == 0
+                                       and ledger_after_close == 0
+                                       and table_after_close == 0),
+        "replicas_stay_probed": probed_ok,
+        "no_unhandled_exceptions": not errors,
+    }
+    verdict["invariants"] = invariants
+    verdict["errors"] = errors
+    verdict["passed"] = all(invariants.values())
+
+    path = os.path.join(outdir, VERDICT_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(verdict, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    _LOG.info("chaos recommender verdict (%s): %s", path,
+              "PASS" if verdict["passed"] else "FAIL")
+    if not verdict["passed"]:
+        from mmlspark_tpu.observability import flightrec
+        dumped = flightrec.dump(
+            reason=f"chaos.recommender.red.seed{seed}",
             path=os.path.join(outdir, "chaos_flightrec.jsonl"))
         if dumped:
             _LOG.error("chaos: flight recorder dumped to %s", dumped)

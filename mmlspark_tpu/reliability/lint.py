@@ -139,6 +139,19 @@ rework established. Scenarios draw load ONLY from loadgen; a
 deliberate hand-rolled stream marks the line
 ``# lint: allow-handload``.
 
+Rule 17 — embedding gather/scatter arithmetic (``segment_sum`` /
+``scatter_add`` calls) or id-bucketing math (``ids // rows_per_shard``,
+``id % num_shards`` — floor-div/mod pairing an id operand with a shard
+operand) outside ``embed/tables.py``: the fused all-to-all lookup and
+the sparse scatter-add gradient are bit-identical to the unsharded
+reference ONLY because every step (bucket capacity, stable sort,
+segment order) lives in one audited home — a private re-implementation
+in a model or serving module silently diverges in association order
+and breaks the recommender's cross-topology bit-identity contract.
+Route through ``embed.tables`` (``make_bag_lookup``,
+``bag_lookup_reference``, ``sparse_table_grads``); deliberate
+exceptions mark the line ``# lint: allow-embed``.
+
 Shared core for ``tools/check_reliability.py`` (standalone CLI),
 ``mmlspark-tpu check`` (installed CLI), and the in-pytest gate
 (tests/test_reliability_lint.py) — same single source of truth pattern as
@@ -242,6 +255,12 @@ _HANDLOAD_HOME = "testing/loadgen.py"
 # Rule 16 scope: the chaos scenario harness only
 _HANDLOAD_SCOPE = "reliability/chaos.py"
 _HANDLOAD_DRAWS = ("randrange", "randint")
+_ALLOW_EMBED = "# lint: allow-embed"
+# the ONE module allowed to open-code embedding gather/scatter and
+# id-bucketing arithmetic (it IS the fused lookup / sparse-grad home
+# whose association order defines the bit-identity contract)
+_EMBED_HOME = "embed/tables.py"
+_EMBED_CALLS = ("segment_sum", "scatter_add")
 
 
 def _is_raw_sync(call: ast.Call) -> bool:
@@ -421,6 +440,37 @@ def _is_signal_signal(call: ast.Call) -> bool:
             and isinstance(f.value, ast.Name) and f.value.id == "signal")
 
 
+def _is_embed_call(call: ast.Call) -> bool:
+    """``segment_sum(...)`` / ``scatter_add(...)`` under any spelling
+    (bare name, ``jax.ops.segment_sum``, ``lax.scatter_add``)."""
+    f = call.func
+    return (isinstance(f, ast.Name) and f.id in _EMBED_CALLS) or \
+        (isinstance(f, ast.Attribute) and f.attr in _EMBED_CALLS)
+
+
+def _mentions_token(node: ast.expr, tokens) -> bool:
+    """Does any identifier in the expression carry one of ``tokens`` as
+    an underscore-separated word (``ids``, ``flat_ids``, ``num_shards``,
+    ``rows_per_shard``)? Word-level matching so ``width``/``grid`` never
+    false-positive on the substring ``id``."""
+    for sub in ast.walk(node):
+        name = sub.id if isinstance(sub, ast.Name) else (
+            sub.attr if isinstance(sub, ast.Attribute) else None)
+        if name and any(t in name.lower().split("_") for t in tokens):
+            return True
+    return False
+
+
+def _is_id_bucketing(binop: ast.BinOp) -> bool:
+    """``ids // rows_per_shard`` / ``id % num_shards``: floor-div or mod
+    pairing an id-named operand with a shard-named one — the owner
+    computation at the heart of the bucketized lookup."""
+    if not isinstance(binop.op, (ast.FloorDiv, ast.Mod)):
+        return False
+    return _mentions_token(binop.left, ("id", "ids")) \
+        and _mentions_token(binop.right, ("shard", "shards"))
+
+
 def check_source(src: str, filename: str = "<src>") -> List[str]:
     """Return ``"file:line: message"`` problems for one module's source."""
     problems: List[str] = []
@@ -448,6 +498,8 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     actuate_scoped = not any(norm.endswith(h) for h in _ACTUATE_HOMES)
     # Rule 16 scope: the chaos scenario harness only
     handload_scoped = norm.endswith(_HANDLOAD_SCOPE)
+    # Rule 17 scope: everywhere, the fused lookup/sparse-grad home exempt
+    embed_scoped = not norm.endswith(_EMBED_HOME)
 
     def _allowed(lineno: int) -> bool:
         # marker anywhere on the offending line opts that line out
@@ -497,6 +549,10 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     def _handload_allowed(lineno: int) -> bool:
         return (0 < lineno <= len(lines)
                 and _ALLOW_HANDLOAD in lines[lineno - 1])
+
+    def _embed_allowed(lineno: int) -> bool:
+        return (0 < lineno <= len(lines)
+                and _ALLOW_EMBED in lines[lineno - 1])
 
     if handload_scoped:
         # Rule 16, comprehension form: randrange/randint draws inside a
@@ -652,6 +708,26 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
                 "actions must stay attributable in the autopilot's "
                 "decision telemetry; route through control.autopilot, "
                 f"or mark the line `{_ALLOW_ACTUATE}`)")
+        elif (isinstance(node, ast.Call) and embed_scoped
+                and _is_embed_call(node)
+                and not _embed_allowed(node.lineno)):
+            problems.append(
+                f"{filename}:{node.lineno}: embedding gather/scatter "
+                f"({node.func.attr if isinstance(node.func, ast.Attribute) else node.func.id}) "  # noqa: E501
+                f"outside {_EMBED_HOME} (bag association order defines "
+                "the sharded-vs-reference bit-identity contract; route "
+                "through embed.tables make_bag_lookup/"
+                "bag_lookup_reference/sparse_table_grads, or mark the "
+                f"line `{_ALLOW_EMBED}`)")
+        elif (isinstance(node, ast.BinOp) and embed_scoped
+                and _is_id_bucketing(node)
+                and not _embed_allowed(node.lineno)):
+            problems.append(
+                f"{filename}:{node.lineno}: id-bucketing arithmetic "
+                f"(id //|% shard) outside {_EMBED_HOME} (shard ownership "
+                "math lives in ONE home so every path agrees which chip "
+                "owns a row; route through embed.tables, or mark the "
+                f"line `{_ALLOW_EMBED}`)")
         elif (isinstance(node, ast.Call) and handload_scoped
                 and _is_handload_rng(node)
                 and not _handload_allowed(node.lineno)):

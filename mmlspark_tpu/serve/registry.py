@@ -91,24 +91,37 @@ class ModelEntry:
         through here exactly once per key — tests wrap this method to
         assert the at-most-one-compile-per-bucket discipline.
 
-        Single-device models AOT-compile through
+        Models AOT-compile through
         :func:`mmlspark_tpu.compile_cache.load_or_compile` — the sanctioned
         seam (lint Rule 9) that loads a verified serialized executable from
         ``runtime.compile_cache_dir`` when one exists and compiles (then
         persists) otherwise, so the cost is paid at a deterministic point
         (first request of a bucket, or an explicit warmup) AND survives
-        restarts/rollouts. Mesh-bound models fall back to the bound apply —
-        ``jax.jit`` under a mesh context still compiles once per shape, the
-        bucketing still bounds the shape set."""
+        restarts/rollouts. Mesh-bound models (sharded recommenders, tensor-
+        parallel scorers) go through the same seam: the lowering picks up
+        the params' NamedShardings, so the persisted executable is the
+        partitioned program — a warm restart of a SHARDED server is zero
+        XLA compiles too. Should a backend refuse to serialize a multi-
+        device executable, the store is counted as a bypass and serving
+        proceeds on the freshly compiled program."""
         from mmlspark_tpu import compile_cache
         apply = self.ensure_apply()
         jitted = getattr(apply, "_jitted", None)
-        if jitted is None or getattr(apply, "_mesh", None) is not None:
+        if jitted is None:
             return apply
         params = apply._params
-        result = compile_cache.load_or_compile(
-            self.name, self.version, bucket, tuple(row_shape), dtype,
-            jitted, params)
+        mesh = getattr(apply, "_mesh", None)
+        if mesh is not None:
+            # trace-time sharding constraints inside apply may name mesh
+            # axes bare — keep the mesh current while lowering
+            with mesh:
+                result = compile_cache.load_or_compile(
+                    self.name, self.version, bucket, tuple(row_shape),
+                    dtype, jitted, params)
+        else:
+            result = compile_cache.load_or_compile(
+                self.name, self.version, bucket, tuple(row_shape), dtype,
+                jitted, params)
         if result.hit:
             self.cache_hits += 1
         else:
@@ -255,11 +268,15 @@ class ModelRegistry:
             ledger.on_eviction(name, freed, resident_bytes=resident,
                                budget_bytes=budget)
         # mirror the warm set into the ledger so the fleet view's
-        # {model, kind} bytes always match the registry's own accounting
+        # {model, kind} bytes always match the registry's own accounting;
+        # embedding-table rows split out as kind="table" so the HBM panel
+        # shows the business-scaling component apart from dense weights
         for name, apply, kv in warm:
             params = getattr(apply, "_params", None) if apply is not None \
                 else None
-            ledger.set_bytes(name, "params", devmem.param_shard_bytes(params))
+            dense, table = devmem.split_param_shard_bytes(params)
+            ledger.set_bytes(name, "params", dense)
+            ledger.set_bytes(name, "table", table)
             ledger.set_bytes(name, "kv", kv)
 
     def _resident(self) -> int:
@@ -268,6 +285,22 @@ class ModelRegistry:
     def resident_bytes(self) -> int:
         with self._lock:
             return self._resident()
+
+    def release(self) -> None:
+        """Close-time teardown: evict every entry and clear its lines
+        from the HBM ledger, so a closed server (a killed fleet replica,
+        a drained rollout victim) leaves ZERO {model, kind} bytes behind
+        — the ledger must reconcile to what is actually resident, and a
+        dead replica's table shards are not. Surviving replicas that
+        share the model name re-mirror their own bytes on their next
+        ``touch``."""
+        with self._lock:
+            entries = list(self._entries.values())
+        ledger = devmem.get_ledger()
+        for e in entries:
+            if e.warm:
+                e.evict()
+            ledger.clear(e.name)
 
     def versions(self) -> Dict[str, str]:
         """Name -> served version (the rollout observability surface)."""

@@ -245,6 +245,9 @@ class Server:
                     t.future.set_exception(ServerOverloaded(
                         "server closed before scoring; retry elsewhere",
                         retry_after=1.0))
+        # the ledger reconciles on close: a dead replica's param/table/kv
+        # lines must not linger in the fleet HBM view
+        self.registry.release()
         if events.events_enabled():
             s = self.stats()
             events.emit("serving", "summary", **s)

@@ -27,6 +27,9 @@ the system behaves. Properties:
   own intent, never from the previous reply.
 - **Shared-prefix prompt populations** (:class:`PromptPopulation`):
   Zipf-weighted prefix reuse for the decode lanes.
+- **Zipf-hot recommender payloads** (:func:`zipf_ids`,
+  :func:`recommender_rows`): packed ``[dense | ids]`` scoring rows with
+  the hot-user/hot-item skew the embedding lanes serve under.
 - **Virtual time** — schedules are data; :class:`EventQueue` and the
   two reference simulators walk them in virtual time, so ~10^5–10^6
   virtual users cost heap events, not threads, and compose with the
@@ -52,7 +55,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 __all__ = [
     "Arrival", "Trace", "rate_at", "peak_rate", "generate",
     "schedule_fingerprint", "bucket_counts", "feature_rows",
-    "token_prompts", "PromptPopulation", "EventQueue",
+    "token_prompts", "zipf_ids", "recommender_rows",
+    "PromptPopulation", "EventQueue",
     "simulate_open_loop", "simulate_closed_loop", "run_open_loop",
 ]
 
@@ -252,6 +256,43 @@ def feature_rows(n: int, rows: int, dim: int, seed: int) -> List[Any]:
     xrng = np.random.default_rng(seed)
     return [xrng.normal(0, 1, (rows, dim)).astype(np.float32)
             for _ in range(n)]
+
+
+def zipf_ids(n: int, *, rows: int, seed: int,
+             zipf_s: float = 1.1) -> Any:
+    """``n`` embedding-row ids in ``[1, rows)`` with Zipf-weighted
+    popularity (id 1 hottest) — the skew real recommender traffic has,
+    where a few hot users/items dominate every lookup batch. Returns an
+    int32 numpy array; id 0 (the pad row, ``embed.tables.PAD_ID``) is
+    never drawn. Same ``(seed, rows, zipf_s)`` -> the same id stream."""
+    import numpy as np
+    if rows < 2:
+        raise ValueError("rows must be >= 2 (id 0 is the reserved pad)")
+    ranks = np.arange(1, rows, dtype=np.float64)
+    w = 1.0 / ranks ** zipf_s
+    rng = np.random.default_rng(seed)
+    ids = rng.choice(np.arange(1, rows, dtype=np.int64), size=n,
+                     p=w / w.sum())
+    return ids.astype(np.int32)
+
+
+def recommender_rows(n: int, *, dense: int,
+                     tables: Sequence[Tuple[int, int]], seed: int,
+                     zipf_s: float = 1.1) -> Any:
+    """``n`` packed recommender scoring rows — float32
+    ``[dense features | slots ids per table]``, the ``embed.model`` wire
+    format — with Zipf-hot ids per sparse feature. ``tables`` is
+    ``((rows, slots), ...)`` in slot order; ids are exact in float32 up
+    to 2^24. One seeded construction shared by the bench serve phase and
+    the chaos recommender scenario (lint Rule 16)."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    cols = [rng.normal(0.0, 1.0, (n, dense)).astype(np.float32)]
+    for j, (rows, slots) in enumerate(tables):
+        ids = zipf_ids(n * slots, rows=rows, seed=seed + 1000 * (j + 1),
+                       zipf_s=zipf_s)
+        cols.append(ids.reshape(n, slots).astype(np.float32))
+    return np.concatenate(cols, axis=1)
 
 
 def token_prompts(n: int, rng: random.Random, *, vocab: int = 200,

@@ -112,6 +112,20 @@ _DEFAULTS: Dict[str, Any] = {
     "parallel.mesh_shape": "",        # "DxT" shorthand, e.g. "4x2" =
                                       # data=4, tensor=2. Takes precedence
                                       # over runtime.mesh; "" defers to it
+    # embed (row-sharded recommender tables; embed/ package — see
+    # docs/RECOMMENDER.md)
+    "embed.row_multiple": 8,          # table rows round up to this multiple
+                                      # so any tensor axis up to it shards
+                                      # every table evenly (the shard
+                                      # granule; rows beyond the declared
+                                      # count are zero pad)
+    "embed.fused_lookup": True,       # tensor meshes use the fused
+                                      # bucketize/all-to-all lookup and the
+                                      # sparse all-gather scatter-add
+                                      # gradient; False falls back to the
+                                      # reference gather (GSPMD partitions
+                                      # it against the sharded table) for
+                                      # numerics triage
     # fleet (multi-replica router + rolling rollout; see docs/SERVING.md)
     "fleet.replicas": 2,              # in-process replicas per Fleet
     "fleet.failover_attempts": 2,     # routing tries per request (1 = no

@@ -487,3 +487,100 @@ def test_streaming_input_bench_runs(tmp_path):
     assert result["vs_baseline"] > 0
     assert result["rows"] == result["batch"] * (result["rows"]
                                                 // result["batch"])
+
+
+# -- multi-hot pad policy: ragged id lists -> fixed slots + weight mask ------
+
+class _RaggedIter(PipelineIterator):
+    """Recommender-style records: dense features + a RAGGED id list whose
+    length varies per record (including empty)."""
+
+    def __init__(self, n: int):
+        self._n, self._i = n, 0
+
+    def __next__(self):
+        if self._i >= self._n:
+            raise StopIteration
+        i = self._i
+        self._i += 1
+        rng = np.random.default_rng(1000 + i)
+        n_ids = int(rng.integers(0, 6))       # 0..5 ids, slots=3 truncates
+        return {"x": rng.normal(size=(4,)).astype(np.float32),
+                "item_ids": [int(v) for v in
+                             rng.integers(1, 50, size=n_ids)]}
+
+    def state_dict(self):
+        return {"i": self._i}
+
+    def load_state_dict(self, state):
+        self._i = int(state["i"])
+
+
+class _Ragged(Dataset):
+    def __init__(self, n: int):
+        self.n = n
+
+    def iter(self, epoch: int = 0) -> PipelineIterator:
+        return _RaggedIter(self.n)
+
+
+def test_multi_hot_pads_truncates_and_masks():
+    from mmlspark_tpu.data.pipeline import MULTI_HOT_PAD_ID
+    ds = _Ragged(9).batch(4, remainder="drop", multi_hot={"item_ids": 3})
+    with ds.iter() as it:
+        batches = list(it)
+    assert len(batches) == 2
+    for b in batches:
+        ids, w = b["item_ids"], b["item_ids_weight"]
+        assert ids.shape == (4, 3) and ids.dtype == np.int32
+        assert w.shape == (4, 3) and w.dtype == np.float32
+        # mask is exactly the non-pad slots, pads carry the pad id
+        assert np.array_equal(w, (ids != MULTI_HOT_PAD_ID)
+                              .astype(np.float32))
+        assert np.all(ids[w == 0.0] == MULTI_HOT_PAD_ID)
+        assert np.all(ids[w == 1.0] >= 1)
+    # per-record check against the raw stream: pad/truncate is front-kept
+    with _Ragged(9).iter() as raw:
+        rows = [next(raw) for _ in range(8)]
+    flat_ids = np.concatenate([b["item_ids"] for b in batches])
+    flat_w = np.concatenate([b["item_ids_weight"] for b in batches])
+    for r, ids, w in zip(rows, flat_ids, flat_w):
+        keep = r["item_ids"][:3]
+        assert list(ids[:len(keep)]) == keep
+        assert w.sum() == len(keep)
+
+
+def test_multi_hot_remainder_pad_composes_with_row_mask():
+    ds = _Ragged(5).batch(4, remainder="pad", multi_hot={"item_ids": 3})
+    with ds.iter() as it:
+        batches = list(it)
+    assert len(batches) == 2
+    tail = batches[-1]
+    # row-level pad mask (the trainer contract) rides alongside the
+    # slot-level multi-hot mask
+    assert np.array_equal(tail["weight"], [1.0, 0.0, 0.0, 0.0])
+    assert tail["item_ids"].shape == (4, 3)
+    assert np.all(tail["item_ids"][1:] == 0)
+    assert np.all(tail["item_ids_weight"][1:] == 0.0)
+
+
+def test_multi_hot_snapshot_resume_bit_identical():
+    ds = _Ragged(16).batch(4, remainder="drop", multi_hot={"item_ids": 3})
+    full, states = [], []
+    with ds.iter() as it:
+        for b in it:
+            full.append(b)
+            states.append(json.loads(json.dumps(it.state_dict())))
+    assert len(full) == 4
+    for k in (0, 2):
+        with ds.iter() as it2:
+            it2.load_state_dict(states[k])
+            tail = list(it2)
+        assert len(tail) == len(full) - (k + 1)
+        for got, want in zip(tail, full[k + 1:]):
+            _batches_equal(got, want)
+
+
+def test_multi_hot_validates_slots():
+    with pytest.raises(ValueError, match="slots"):
+        Batcher(_Ragged(4), 2, multi_hot={"item_ids": 0})

@@ -186,3 +186,46 @@ def test_assemble_unseen_tokens_ignored_at_transform():
     X = out.column("feats")
     assert X.shape[1] == 2      # only alpha/beta slots exist
     assert X.sum() == 1.0       # unseen token contributes nothing
+
+
+# -- HashIndexer: vocabulary-free categorical -> embedding-table ids ---------
+
+def test_hash_indexer_stable_in_range_and_pad_nulls():
+    from mmlspark_tpu.feature.value_indexer import HashIndexer
+    from mmlspark_tpu.ops.hashing import murmur3_batch
+    f = Frame.from_dict({"s": ["user_a", "user_b", None, "user_a"]})
+    hi = HashIndexer(inputCol="s", outputCol="id", numBuckets=100)
+    out = hi.transform(f)
+    ids = out.column("id")
+    assert ids.dtype == np.int32
+    # null -> pad id 0; real values land in [1, numBuckets)
+    assert ids[2] == 0
+    assert all(1 <= i < 100 for i in (ids[0], ids[1], ids[3]))
+    assert ids[0] == ids[3]                       # same value, same bucket
+    # the bucket IS the documented murmur3 formula (cross-process stable)
+    want = 1 + int(murmur3_batch(["user_a"]).astype(np.int64)[0]) % 99
+    assert ids[0] == want
+    # identical on a rerun (no hidden state)
+    assert np.array_equal(hi.transform(f).column("id"), ids)
+    assert out.schema["id"].metadata["hash_buckets"] == 100
+    assert out.schema["id"].metadata["pad_id"] == 0
+
+
+def test_hash_indexer_numeric_spellings_agree():
+    from mmlspark_tpu.feature.value_indexer import HashIndexer
+    hi = HashIndexer(inputCol="v", outputCol="id", numBuckets=64)
+    a = hi.transform(Frame.from_dict({"v": np.array([3, 7], np.int64)}))
+    b = hi.transform(Frame.from_dict({"v": np.array([3.0, 7.0])}))
+    # a column that arrives int64 in training and float64 in serving
+    # must index identically
+    assert np.array_equal(a.column("id"), b.column("id"))
+
+
+def test_hash_indexer_rejects_non_categorical_and_tiny_space():
+    from mmlspark_tpu.core.schema import SchemaError
+    from mmlspark_tpu.feature.value_indexer import HashIndexer
+    f = Frame.from_dict({"x": [np.zeros(3, np.float32)]})
+    with pytest.raises(SchemaError):
+        HashIndexer(inputCol="x", outputCol="id").transform(f)
+    with pytest.raises(ValueError):
+        HashIndexer(inputCol="x", outputCol="id", numBuckets=1)

@@ -132,7 +132,8 @@ def test_ledger_set_total_snapshot_and_hwm():
     assert led.total(kind="params") == 1200
     snap = led.snapshot()
     assert snap["total_bytes"] == 1700
-    assert snap["by_kind"] == {"params": 1200, "kv": 500, "program": 0}
+    assert snap["by_kind"] == {"params": 1200, "table": 0, "kv": 500,
+                               "program": 0}
     assert snap["by_model"]["a"] == {"params": 1000, "kv": 500}
     # high-watermark is monotonic through clears
     led.clear("a")

@@ -113,7 +113,8 @@ def _configs():
     from mmlspark_tpu.feature.text import (
         HashingTF, IDF, NGram, RegexTokenizer, StopWordsRemover,
         TextFeaturizer)
-    from mmlspark_tpu.feature.value_indexer import IndexToValue, ValueIndexer
+    from mmlspark_tpu.feature.value_indexer import (
+        HashIndexer, IndexToValue, ValueIndexer)
     from mmlspark_tpu.feature.word2vec import Word2Vec
     from mmlspark_tpu.image.transformer import ImageTransformer, UnrollImage
     from mmlspark_tpu.stages.stages import (
@@ -150,6 +151,8 @@ def _configs():
                          _text_frame),
         "IndexToValue": (lambda: IndexToValue(inputCol="idx", outputCol="orig"),
                          value_indexed),
+        "HashIndexer": (lambda: HashIndexer(inputCol="col0", outputCol="id",
+                                            numBuckets=64), _text_frame),
         "Featurize": (lambda: Featurize(featureColumns={
             "features": ["col0", "col1", "col2", "col3"]}, numberOfFeatures=64),
             _mixed_frame),

@@ -151,6 +151,32 @@ def test_feature_rows_byte_identical_to_the_seeded_generator():
     assert all(g.dtype == np.float32 for g in got)
 
 
+def test_zipf_ids_seeded_hot_skewed_and_never_pad():
+    a = loadgen.zipf_ids(4096, rows=64, seed=7)
+    b = loadgen.zipf_ids(4096, rows=64, seed=7)
+    assert np.array_equal(a, b) and a.dtype == np.int32
+    assert a.min() >= 1 and a.max() < 64          # pad id 0 never drawn
+    counts = np.bincount(a, minlength=64)
+    assert counts[1] == counts.max()              # id 1 is the hot head
+    assert counts[1] > 3 * counts[32:].max()
+    with pytest.raises(ValueError):
+        loadgen.zipf_ids(4, rows=1, seed=0)
+
+
+def test_recommender_rows_packs_dense_then_per_table_id_blocks():
+    tables = ((64, 2), (128, 3))
+    a = loadgen.recommender_rows(16, dense=4, tables=tables, seed=9)
+    b = loadgen.recommender_rows(16, dense=4, tables=tables, seed=9)
+    assert np.array_equal(a, b)
+    assert a.dtype == np.float32 and a.shape == (16, 4 + 2 + 3)
+    ids0 = a[:, 4:6].astype(np.int64)
+    ids1 = a[:, 6:9].astype(np.int64)
+    assert ids0.min() >= 1 and ids0.max() < 64
+    assert ids1.min() >= 1 and ids1.max() < 128
+    # id columns round-trip the float32 packing exactly
+    assert np.array_equal(ids0.astype(np.float32), a[:, 4:6])
+
+
 def test_token_prompts_deterministic_on_the_callers_stream():
     a = token_prompts(6, random.Random(5))
     b = token_prompts(6, random.Random(5))

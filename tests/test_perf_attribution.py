@@ -685,6 +685,41 @@ def test_gate_goodput_and_arrival_p99_are_gated_fields():
     assert v["green"] is True
 
 
+def test_gate_latency_noise_guards_absorb_sub_jitter_rises_only():
+    def sv(**kw):
+        return _line(sv=dict(_lane(), goodput=1.0, deadline_ms=250.0, **kw))
+
+    # resolution floor: +4.5 ms on a 40 ms p99 fails the 10% ratio but
+    # is beneath what the host can resolve (and 44.5 ms is outside the
+    # 25 ms deep-headroom band, so the floor is what saves it)
+    v = compare(sv(arrival_p99_ms=44.5), sv(arrival_p99_ms=40.0))
+    assert v["green"] is True
+    c = next(c for c in v["lanes"]["sv"]["checks"]
+             if c["metric"] == "arrival_p99_ms")
+    assert c["ok"] and c["floor_ms"] == 5.0
+    # past the floor and outside the headroom band the ratio gate bites
+    v = compare(sv(arrival_p99_ms=48.0), sv(arrival_p99_ms=40.0))
+    assert v["red"] == ["sv"]
+    # deep headroom: 8 -> 19 ms under a 250 ms deadline is host noise
+    # far from the knee (both sides within 10% of the deadline)
+    v = compare(sv(arrival_p99_ms=19.0), sv(arrival_p99_ms=8.0))
+    assert v["green"] is True
+    c = next(c for c in v["lanes"]["sv"]["checks"]
+             if c["metric"] == "arrival_p99_ms")
+    assert c["ok"] and c["headroom_ms"] == 25.0
+    # crossing OUT of the band still reds
+    v = compare(sv(arrival_p99_ms=30.0), sv(arrival_p99_ms=8.0))
+    assert v["red"] == ["sv"]
+    # the guards are for tail percentiles only: a small absolute
+    # step_ms rise (a mean, where 2 ms IS signal) and a throughput
+    # drop both stay red
+    v = compare(sv(arrival_p99_ms=8.0, step_ms=12.0),
+                sv(arrival_p99_ms=8.0))
+    assert v["red"] == ["sv"]
+    v = compare(sv(arrival_p99_ms=8.0, value=80.0), sv(arrival_p99_ms=8.0))
+    assert v["red"] == ["sv"]
+
+
 def test_load_baseline_accepts_wrapper_and_raw_forms(tmp_path):
     raw = _line(train=_lane())
     p_raw = tmp_path / "raw.json"

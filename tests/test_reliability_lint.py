@@ -798,3 +798,61 @@ def test_handload_rule_marker_and_other_files_exempt():
         unmarked, filename="mmlspark_tpu/testing/loadgen.py") == []
     assert lint.check_source(
         unmarked, filename="mmlspark_tpu/serve/router.py") == []
+
+
+# -- Rule 17: embedding gather/scatter + id-bucketing home -------------------
+
+def test_embed_rule_flags_gather_scatter_and_bucketing():
+    src = textwrap.dedent("""
+        import jax
+
+        def my_lookup(table, ids, weights, rows_per_shard):
+            owner = ids // rows_per_shard
+            slot = ids % num_shards
+            bags = jax.ops.segment_sum(table, ids, num_segments=4)
+            grad = jax.lax.scatter_add(table, ids, weights, dims)
+            return owner, slot, bags, grad
+    """)
+    probs = lint.check_source(src, filename="mmlspark_tpu/models/custom.py")
+    assert len(probs) == 4
+    assert any("segment_sum" in p for p in probs)
+    assert any("scatter_add" in p for p in probs)
+    assert sum("id-bucketing" in p for p in probs) == 2
+    assert all("embed/tables.py" in p for p in probs)   # sanctioned home
+    assert all("allow-embed" in p for p in probs)       # escape hatch named
+
+
+def test_embed_rule_home_exempt_and_marker_honored():
+    src = textwrap.dedent("""
+        import jax
+
+        def body(tab, flat, rows_per_shard):
+            owner = flat_ids // rows_per_shard
+            return jax.ops.segment_sum(tab, owner, num_segments=2)
+    """)
+    # the fused-lookup home open-codes freely
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/embed/tables.py") == []
+    marked = textwrap.dedent("""
+        import jax
+
+        def body(tab, ids, rows_per_shard):
+            owner = ids // rows_per_shard  # lint: allow-embed
+            return jax.ops.segment_sum(  # lint: allow-embed
+                tab, owner, num_segments=2)
+    """)
+    assert lint.check_source(
+        marked, filename="mmlspark_tpu/serve/scoring.py") == []
+
+
+def test_embed_rule_benign_arithmetic_not_flagged():
+    # floor-div/mod without the id/shard operand pairing is ordinary math
+    src = textwrap.dedent("""
+        def layout(width, grid, num_shards, ids):
+            cols = width // grid
+            rem = width % num_shards
+            half = ids // 2
+            return cols, rem, half
+    """)
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/models/custom.py") == []
