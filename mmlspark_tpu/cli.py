@@ -769,6 +769,11 @@ def cmd_chaos(args, passthrough) -> int:
     row-sharded embedding tables resident; zero failed requests,
     scores bit-identical to an unsharded single server, and the HBM
     ledger's kind="table" lines reconcile to zero on close.
+    ``--scenario fleetprefix``: kill the replica holding the hottest
+    ADVERTISED prefix chains mid-stream (docs/SERVING.md "fleet as one
+    cache"); zero failed requests, survivors absorb the session keys,
+    tokens bit-identical to a single server, and the prefix hit rate
+    recovers with zero new compiles.
     Writes ``chaos_verdict.json`` under --out; exit 0 iff every
     invariant held."""
     if (args.scenario.endswith("_sharded")
@@ -817,6 +822,10 @@ def cmd_chaos(args, passthrough) -> int:
             requests=args.requests)
     elif args.scenario == "recommender":
         verdict = chaos.run_recommender_scenario(
+            args.seed, outdir, replicas=args.replicas,
+            requests=args.requests)
+    elif args.scenario == "fleetprefix":
+        verdict = chaos.run_fleetprefix_scenario(
             args.seed, outdir, replicas=args.replicas,
             requests=args.requests)
     else:

@@ -307,6 +307,23 @@ class FleetScraper:
                 latency["buckets"], 50), 3)
             stats["p99_ms"] = round(metrics.percentile_from_buckets(
                 latency["buckets"], 99), 3)
+        # prefix-digest advertisement (metrics-adjacent JSON endpoint;
+        # serve/http.py). Best-effort: an older replica without the
+        # route is still a healthy scrape target.
+        try:
+            import json as _json
+            with urllib.request.urlopen(base + "/affinity",
+                                        timeout=self.timeout_s) as resp:
+                adv = _json.loads(resp.read().decode("utf-8", "replace"))
+            for model, d in (adv.get("digests") or {}).items():
+                stats[f"generate.{model}.kv.resident_chains"] = \
+                    d.get("chains") or []
+                stats[f"generate.{model}.kv.kv_dtype"] = \
+                    str(d.get("kv_dtype") or "")
+                stats[f"generate.{model}.kv.block_tokens"] = \
+                    d.get("block_tokens")
+        except Exception as e:
+            logger.debug("affinity scrape skipped for %s: %s", base, e)
         return {"ready": ready, "live": live,
                 "state": "ready" if ready else "draining",
                 "stats": stats, "latency": latency, "metrics": parsed}
@@ -399,6 +416,7 @@ class FleetScraper:
             totals["failovers"] = float(rs.get("failovers", 0))
             totals["all_shed"] = float(rs.get("all_shed", 0))
             snap["router"] = rs
+        self._publish_digests(snap)
         snap["fleet"] = totals
         snap["memory"] = devmem.get_ledger().snapshot()
         self._last = snap
@@ -411,6 +429,33 @@ class FleetScraper:
     @property
     def last(self) -> Optional[Dict[str, Any]]:
         return self._last
+
+    def _publish_digests(self, snap: Dict[str, Any]) -> None:
+        """Fleet-wide prefix-digest pull (docs/SERVING.md "fleet as one
+        cache"): each replica's ``generate.<model>.kv.resident_chains``
+        summary — a structured stats value the numeric totals above
+        skip — is published into the router's shared
+        :class:`~mmlspark_tpu.serve.affinity.AffinityState`, which is
+        what the router scores generate picks against. A no-op without
+        a router or with affinity disabled."""
+        aff = getattr(self.router, "affinity", None)
+        if aff is None:
+            return
+        tail = ".kv.resident_chains"
+        for name, one in snap["replicas"].items():
+            stats = one.get("stats") or {}
+            for k, v in stats.items():
+                if not (k.startswith("generate.") and k.endswith(tail)
+                        and isinstance(v, list)):
+                    continue
+                model = k[len("generate."):-len(tail)]
+                aff.update_digest(
+                    name, model, v,
+                    kv_dtype=stats.get(f"generate.{model}.kv.kv_dtype"),
+                    block_tokens=stats.get(
+                        f"generate.{model}.kv.block_tokens"),
+                    ts=snap["ts"])
+        snap["affinity"] = aff.snapshot()
 
     def _update_registry(self, snap: Dict[str, Any]) -> None:
         reg = self.registry

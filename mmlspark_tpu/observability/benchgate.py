@@ -189,7 +189,12 @@ def compare(fresh: Dict[str, Any], baseline: Dict[str, Any],
                               ("shed_rate", False),
                               ("spike_p99_ms", False),
                               ("goodput", True),
-                              ("arrival_p99_ms", False)):
+                              ("arrival_p99_ms", False),
+                              # fleet-wide prefix re-use under affinity
+                              # routing: a change that stops the router
+                              # steering repeats to warm replicas IS a
+                              # regression, so this one gates
+                              ("fleet_prefix_hit_rate", True)):
             c = _check(field, _num(fresh_lane, field),
                        _num(base_lane, field), tolerance, higher)
             if c is None:
@@ -258,7 +263,11 @@ def compare(fresh: Dict[str, Any], baseline: Dict[str, Any],
                                    ("suppressed", False),
                                    ("time_to_recover_s", False),
                                    ("spawn_to_ready_ms", False),
-                                   ("steady_compiles", False)):
+                                   ("steady_compiles", False),
+                                   # routing-mode split: a workload
+                                   # signature (how often affinity found
+                                   # a signal), not a regression axis
+                                   ("affinity_route_share", True)):
             c = _check(info_field, _num(fresh_lane, info_field),
                        _num(base_lane, info_field), tolerance, higher)
             if c is not None:

@@ -162,6 +162,23 @@ class TopDashboard:
                 parts.append(f"int8 saved {format_bytes(max(0.0, saved))}")
             lines.append("decode   " + "  ".join(parts))
 
+        # prefix-affinity routing: how the router split traffic (prefix /
+        # session / plain WRR) and what each replica is advertising — the
+        # live view of "N replicas, one cache"
+        aff = snap.get("affinity")
+        if aff and aff.get("routes"):
+            parts = [
+                f"routes {aff['routes']:.0f}",
+                f"prefix {aff.get('routes_prefix', 0):.0f}",
+                f"session {aff.get('routes_session', 0):.0f}",
+                f"wrr {aff.get('routes_wrr', 0):.0f}",
+                f"share {100.0 * aff.get('affinity_route_share', 0.0):.1f}%"]
+            adv = aff.get("advertised") or []
+            if adv:
+                parts.append("adv " + ", ".join(
+                    f"{d['replica']}:{d['max_depth']}" for d in adv[:6]))
+            lines.append("affinity " + "  ".join(parts))
+
         for st in slo_status or []:
             flag = "BREACH" if st["breaching"] else (
                 "burn" if st["burning"] else "ok")

@@ -440,6 +440,43 @@ def build_report(path, top: int = 10,
             fl["rollouts"] = list(by_target.values())
         report["fleet"] = fl
 
+    # -- affinity (prefix-digest routing) ----------------------------------
+    aff_ev = [e for e in events if e.get("type") == "affinity"]
+    if aff_ev:
+        routes = [e for e in aff_ev if e.get("name") == "route"]
+        af: Dict[str, Any] = {}
+        if routes:
+            by_mode: Dict[str, int] = defaultdict(int)
+            by_rep: Dict[str, int] = defaultdict(int)
+            hist: Dict[int, int] = defaultdict(int)
+            for e in routes:
+                by_mode[e.get("mode", "?")] += 1
+                by_rep[e.get("replica", "?")] += 1
+                if e.get("mode") == "prefix":
+                    hist[int(e.get("depth", 0))] += 1
+            n = len(routes)
+            af["routes"] = n
+            af["by_mode"] = dict(sorted(by_mode.items()))
+            af["by_replica"] = dict(sorted(by_rep.items()))
+            af["affinity_route_share"] = round(
+                (n - by_mode.get("wrr", 0)) / n, 4)
+            if hist:
+                af["hit_depth_hist"] = {str(k): v for k, v
+                                        in sorted(hist.items())}
+        adverts = [e for e in aff_ev if e.get("name") == "advertise"]
+        if adverts:
+            latest: Dict[Any, Dict[str, Any]] = {}
+            for e in adverts:    # last write wins: the current digest
+                latest[(e.get("replica", "?"), e.get("model", "?"))] = {
+                    "replica": e.get("replica", "?"),
+                    "model": e.get("model", "?"),
+                    "chains": int(e.get("chains", 0)),
+                    "max_depth": int(e.get("max_depth", 0))}
+            af["advertised"] = sorted(
+                latest.values(),
+                key=lambda d: (d["replica"], d["model"]))
+        report["affinity"] = af
+
     # -- supervisor (process-fleet restart decisions) ----------------------
     sup_ev = [e for e in events if e.get("type") == "supervisor"]
     if sup_ev:
@@ -854,6 +891,28 @@ def render_report(path, top: int = 10) -> str:
                 f"  rollout {ro['model']} -> {ro['version']}: "
                 f"{ro['shifted']} replica(s) shifted, "
                 f"{ro['warmed']} warmed, {ro['status']}")
+        out.append("")
+
+    if "affinity" in r:
+        af = r["affinity"]
+        out.append("affinity (prefix-digest routing):")
+        if "routes" in af:
+            detail = ", ".join(f"{k}={v}"
+                               for k, v in af["by_mode"].items())
+            out.append(f"  routes: {af['routes']} ({detail}; "
+                       f"affinity share "
+                       f"{af['affinity_route_share'] * 100:.1f}%)")
+            detail = ", ".join(f"{k}={v}"
+                               for k, v in af["by_replica"].items())
+            out.append(f"  by replica: {detail}")
+        if "hit_depth_hist" in af:
+            detail = ", ".join(f"depth {k}: {v}" for k, v in
+                               af["hit_depth_hist"].items())
+            out.append(f"  expected hit depth: {detail}")
+        for ad in af.get("advertised", ()):
+            out.append(
+                f"  advertised {ad['replica']}/{ad['model']}: "
+                f"{ad['chains']} chain(s), max depth {ad['max_depth']}")
         out.append("")
 
     if "supervisor" in r:

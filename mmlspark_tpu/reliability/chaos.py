@@ -82,6 +82,11 @@ SCENARIOS: Dict[str, str] = {
                    "bit-identical to an unsharded single server, and the "
                    "HBM ledger's kind=\"table\" lines reconcile to zero "
                    "on close",
+    "fleetprefix": "kill the replica holding the hottest advertised "
+                   "prefix chains mid-stream; zero failed requests, "
+                   "survivors absorb the sessions, tokens bit-identical "
+                   "to a single server, and the prefix hit rate recovers "
+                   "with zero new compiles",
 }
 
 # the 2-D topology the *_sharded scenarios run on: tensor=2 model axis,
@@ -1094,6 +1099,288 @@ def _run_shared_prefix_kill(model, rng, seed: int,
             "victim_error_surfaced": victim_surfaced,
         },
     }
+
+
+# -- fleetprefix scenario ----------------------------------------------------
+
+def run_fleetprefix_scenario(seed: int, outdir: str, replicas: int = 3,
+                             requests: int = 12) -> Dict[str, Any]:
+    """Kill the replica holding the HOTTEST advertised prefix chains.
+
+    The affinity subsystem's chaos counterpart: prefix-digest routing
+    deliberately concentrates a Zipf-hot system prompt's KV blocks on
+    one replica — which makes that replica's death the worst case the
+    "N replicas, one cache" story has to survive. The scenario builds
+    exactly that concentration, then kills it mid-stream.
+
+    1. **reference** — every request generated on a single
+       :class:`~mmlspark_tpu.serve.server.Server`: the token ground
+       truth (and the shared compile cache every fleet replica loads
+       from — what makes ``steady_compiles_zero`` assertable).
+    2. **warm** — a seeded Zipf :class:`~mmlspark_tpu.testing.loadgen.
+       PromptPopulation` round through the fleet under plain WRR (no
+       digests exist yet), then one :class:`FleetScraper` scrape pulls
+       every replica's advertised chains into the router's
+       :class:`~mmlspark_tpu.serve.affinity.AffinityState`.
+    3. **kill** — a rank-0 (hottest prefix) victim request is submitted;
+       affinity steers it to a deepest-chain leader, and the harness
+       kills the replica actually decoding it mid-stream. Failover
+       restarts the sequence from its prompt, re-scored against the
+       SURVIVORS' digests.
+    4. **recover** — a session-keyed round: every session lands on a
+       survivor, re-uses cached prefixes, and compiles nothing.
+
+    Invariants (verdict JSON, ``outdir/chaos_verdict.json``):
+
+    - ``all_sequences_complete``  — every request (victim included)
+      returned a finished stream: zero failed requests through the kill;
+    - ``tokens_bit_identical``    — fleet tokens == single-server tokens
+      for every request, through kill, failover, and session rounds;
+    - ``victim_routed_to_leader`` — the kill landed on a replica the
+      digest scoring named a deepest-chain leader for the victim prompt
+      (the router concentrated the hot prefix where it claimed);
+    - ``failover_observed``       — the kill really forced >= 1 failover;
+    - ``sessions_absorbed``       — no post-kill request routed to the
+      dead replica (session ring + candidate filter exclude it);
+    - ``hit_rate_recovers``       — the recovery round re-used cached
+      prefix blocks on survivors (summed per-request ``prefix_hits`` >
+      0);
+    - ``steady_compiles_zero``    — survivors absorbed the victim's
+      sessions with ZERO new XLA compiles;
+    - ``no_unhandled_exceptions`` — nothing escaped the router/retry
+      channel.
+
+    Everything — prompts, routing order, the victim, the verdict — is a
+    pure function of ``seed``.
+    """
+    import threading
+    import time as _time
+
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.observability.aggregate import FleetScraper
+    from mmlspark_tpu.serve import affinity as aff_mod
+    from mmlspark_tpu.serve.fleet import Fleet
+    from mmlspark_tpu.serve.kvcache import prefix_block_hashes
+    from mmlspark_tpu.serve.server import Server
+    from mmlspark_tpu.utils import config as mmlconfig
+
+    os.makedirs(outdir, exist_ok=True)
+    errors: List[str] = []
+    verdict: Dict[str, Any] = {"seed": seed, "scenario": "fleetprefix",
+                               "replicas": replicas, "requests": requests}
+
+    rng = random.Random(seed ^ 0xAFF1)
+    prior = {k: mmlconfig.get(k) for k in
+             ("generate.max_seq_len", "generate.max_sequences",
+              "generate.kv_block_tokens", "generate.advertise_top_k",
+              "fleet.affinity_enabled", "fleet.affinity_min_depth",
+              "runtime.compile_cache_dir")}
+    mmlconfig.set("generate.max_seq_len", 64)
+    mmlconfig.set("generate.max_sequences", 4)
+    mmlconfig.set("generate.kv_block_tokens", 8)
+    mmlconfig.set("generate.advertise_top_k", 8)
+    mmlconfig.set("fleet.affinity_enabled", True)
+    mmlconfig.set("fleet.affinity_min_depth", 1)
+    mmlconfig.set("runtime.compile_cache_dir",
+                  os.path.join(outdir, "compile_cache"))
+
+    bt = 8
+    pop = loadgen.PromptPopulation(rng, prefixes=3, prefix_tokens=2 * bt,
+                                   vocab=200, zipf_s=1.2)
+    warm_prompts = [pop.sample(tail_tokens=2) for _ in range(requests)]
+    # the victim rides the HOTTEST prefix; a fixed tail keeps the prompt
+    # a pure function of the population (itself a pure function of seed)
+    victim_prompt = pop.prefix(0) + [5, 7]
+    sess_prompts = [pop.sample(tail_tokens=2)
+                    for _ in range(max(2, requests // 2))]
+
+    def _rank(prompt: List[int]) -> int:
+        return next(r for r in range(3)
+                    if prompt[:2 * bt] == pop.prefix(r))
+
+    # per-request decode lengths: scenario parameters, not a payload
+    # stream; the victim decodes long enough for the kill to land
+    warm_new = [rng.randint(4, 8) for _ in warm_prompts]  # lint: allow-handload
+    sess_new = [rng.randint(4, 8) for _ in sess_prompts]  # lint: allow-handload
+    victim_new = 24
+
+    model = JaxModel().set_model("transformer_lm_tiny", seed=seed & 0xFFFF)
+
+    reference: List[List[int]] = []
+    results: List[Optional[Dict[str, Any]]] = []
+    killed_replica = ""
+    leaders: List[str] = []
+    failovers = 0
+    kill_at = -1
+    compile_delta = -1
+    recover_hits = -1
+    route_log: List[str] = []
+    all_prompts = warm_prompts + [victim_prompt] + sess_prompts
+    all_new = warm_new + [victim_new] + sess_new
+    try:
+        # phase 1: single-server token ground truth (+ compile cache)
+        ref_server = Server({"lm": model})
+        try:
+            for i, p in enumerate(all_prompts):
+                reference.append(ref_server.generate(
+                    "lm", p, max_new_tokens=all_new[i],
+                    seed=seed + i, timeout=60)["tokens"])
+        finally:
+            ref_server.close()
+
+        fleet = Fleet({"lm": model}, replicas=replicas)
+        fleet.router.route_log = route_log
+        scraper = FleetScraper(fleet)
+        try:
+            # phase 2: warm round (WRR — nothing advertised yet), then
+            # one scrape publishes every replica's digest
+            for i, p in enumerate(warm_prompts):
+                try:
+                    results.append(fleet.submit_generate(
+                        "lm", p, max_new_tokens=warm_new[i],
+                        seed=seed + i))
+                except Exception as e:
+                    results.append(None)
+                    errors.append(f"warm {i}: {type(e).__name__}: {e}")
+            scraper.scrape()
+            aff = fleet.router.affinity
+            kv_dtype = fleet.replicas[0].server.stats().get(
+                "generate.lm.kv.kv_dtype", "float32")
+            vh = prefix_block_hashes("lm", str(kv_dtype),
+                                     victim_prompt, bt)
+            scores = {r.name: aff_mod.score_digest(
+                aff.digest_for(r.name, "lm"), vh)
+                for r in fleet.replicas}
+            best = max(scores.values())
+            leaders = sorted(n for n, s in scores.items() if s == best)
+
+            # phase 3: the victim decodes on a deepest-chain leader; the
+            # harness kills whichever replica is actually stepping it
+            vidx = len(warm_prompts)
+            base = {r.name: (r.server._lanes["lm"].steps
+                             if "lm" in r.server._lanes else 0)
+                    for r in fleet.replicas}
+            box: Dict[str, Any] = {}
+
+            def _client():
+                try:
+                    box["out"] = fleet.submit_generate(
+                        "lm", victim_prompt, max_new_tokens=victim_new,
+                        seed=seed + vidx)
+                except Exception as e:
+                    box["err"] = e
+
+            plan = FaultPlan(FaultSpec(
+                "generate.step", on_hit=1, times=10_000,
+                action="delay", delay=0.002))
+            with plan:
+                t = threading.Thread(
+                    target=_client, daemon=True,
+                    name="mmlspark-tpu-chaos-fleetprefix-client")
+                t.start()
+                deadline = _time.monotonic() + 30
+                while (not killed_replica
+                       and _time.monotonic() < deadline):
+                    for j, rep in enumerate(fleet.replicas):
+                        lane = rep.server._lanes.get("lm")
+                        if (lane is not None
+                                and lane.steps > base[rep.name]):
+                            fleet.kill(j)  # lint: allow-actuate
+                            killed_replica = rep.name
+                            kill_at = len(route_log)
+                            break
+                    _time.sleep(0.0005)
+                t.join(60)
+            if not killed_replica:
+                errors.append("kill never landed: no replica was "
+                              "observed decoding the victim")
+            if t.is_alive():
+                errors.append("victim client wedged")
+                results.append(None)
+            elif "err" in box:
+                results.append(None)
+                errors.append(f"victim: {type(box['err']).__name__}: "
+                              f"{box['err']}")
+            else:
+                results.append(box.get("out"))
+
+            # phase 4: session-keyed recovery round on the survivors —
+            # fresh digests first, then zero new compiles allowed
+            scraper.scrape()
+            survivors = [r for r in fleet.replicas if not r._dead]
+            pre = {r.name: int(r.server.stats().get(
+                "registry.compiles", 0)) for r in survivors}
+            hits = 0
+            for i, p in enumerate(sess_prompts):
+                gi = vidx + 1 + i
+                try:
+                    out = fleet.submit_generate(
+                        "lm", p, max_new_tokens=sess_new[i],
+                        seed=seed + gi, session=f"sess{_rank(p)}")
+                    results.append(out)
+                    hits += int(out.get("prefix_hits", 0))
+                except Exception as e:
+                    results.append(None)
+                    errors.append(f"session {i}: {type(e).__name__}: {e}")
+            recover_hits = hits
+            compile_delta = sum(
+                int(r.server.stats().get("registry.compiles", 0))
+                - pre[r.name] for r in survivors)
+            failovers = int(fleet.router.stats()["failovers"])
+            verdict["affinity"] = fleet.router.affinity.snapshot()
+        finally:
+            fleet.close()
+    except Exception as e:
+        errors.append(f"fleetprefix scenario: {type(e).__name__}: {e}")
+    finally:
+        for k, v in prior.items():
+            mmlconfig.set(k, v)
+
+    finished = [r is not None and r.get("finish_reason")
+                in ("length", "stop") for r in results]
+    identical = (len(results) == len(reference)
+                 and all(r is not None and r["tokens"] == ref
+                         for r, ref in zip(results, reference)))
+    post_kill = route_log[kill_at:] if kill_at >= 0 else []
+    verdict["schedule"] = {
+        "killed_replica": killed_replica, "leaders": leaders,
+        "victim_rank": 0, "kill_at": kill_at, "route_log": route_log,
+        "warm_new": warm_new, "sess_new": sess_new,
+        "failovers": failovers,
+    }
+    verdict["recover"] = {"prefix_hits": recover_hits,
+                          "compile_delta": compile_delta}
+    invariants = {
+        "all_sequences_complete": bool(results) and all(finished),
+        "tokens_bit_identical": identical,
+        "victim_routed_to_leader": bool(killed_replica)
+        and killed_replica in leaders,
+        "failover_observed": failovers >= 1,
+        "sessions_absorbed": bool(post_kill)
+        and killed_replica not in post_kill,
+        "hit_rate_recovers": recover_hits > 0,
+        "steady_compiles_zero": compile_delta == 0,
+        "no_unhandled_exceptions": not errors,
+    }
+    verdict["invariants"] = invariants
+    verdict["errors"] = errors
+    verdict["passed"] = all(invariants.values())
+
+    path = os.path.join(outdir, VERDICT_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(verdict, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    _LOG.info("chaos fleetprefix verdict (%s): %s", path,
+              "PASS" if verdict["passed"] else "FAIL")
+    if not verdict["passed"]:
+        from mmlspark_tpu.observability import flightrec
+        dumped = flightrec.dump(
+            reason=f"chaos.fleetprefix.red.seed{seed}",
+            path=os.path.join(outdir, "chaos_flightrec.jsonl"))
+        if dumped:
+            _LOG.error("chaos: flight recorder dumped to %s", dumped)
+    return verdict
 
 
 # -- host scenario -----------------------------------------------------------

@@ -152,6 +152,17 @@ Route through ``embed.tables`` (``make_bag_lookup``,
 ``bag_lookup_reference``, ``sparse_table_grads``); deliberate
 exceptions mark the line ``# lint: allow-embed``.
 
+Rule 18 — consistent-hash / digest-scoring arithmetic outside
+``serve/affinity.py``: a ring point minted from a truncated
+cryptographic digest (``int(sha256(...).hexdigest()[:16], 16)``) or
+vnode/ring modular bucketing math is placement policy the WHOLE fleet
+must agree on — a private ring in a scenario, bench, or second serving
+module assigns the same session key to a different replica than the
+router does, and the "N replicas, one KV cache" contract silently
+splits. The scoring/ring home is ``serve.affinity``
+(``ConsistentHashRing``, ``score_digest``); deliberate exceptions mark
+the line ``# lint: allow-affinity``.
+
 Shared core for ``tools/check_reliability.py`` (standalone CLI),
 ``mmlspark-tpu check`` (installed CLI), and the in-pytest gate
 (tests/test_reliability_lint.py) — same single source of truth pattern as
@@ -261,6 +272,11 @@ _ALLOW_EMBED = "# lint: allow-embed"
 # whose association order defines the bit-identity contract)
 _EMBED_HOME = "embed/tables.py"
 _EMBED_CALLS = ("segment_sum", "scatter_add")
+_ALLOW_AFFINITY = "# lint: allow-affinity"
+# the ONE module allowed to mint ring points from digests and open-code
+# vnode/ring bucketing (it IS the placement policy every router, bench,
+# and scenario must agree with)
+_AFFINITY_HOME = "serve/affinity.py"
 
 
 def _is_raw_sync(call: ast.Call) -> bool:
@@ -471,6 +487,33 @@ def _is_id_bucketing(binop: ast.BinOp) -> bool:
         and _mentions_token(binop.right, ("shard", "shards"))
 
 
+def _is_ring_point(call: ast.Call) -> bool:
+    """``int(<...>.hexdigest()<...>, 16)`` — a cryptographic digest
+    truncated into a base-16 integer, the signature of a ring point (or
+    any other hash-derived placement key) being minted inline."""
+    f = call.func
+    if not (isinstance(f, ast.Name) and f.id == "int"):
+        return False
+    if len(call.args) != 2:
+        return False
+    base = call.args[1]
+    if not (isinstance(base, ast.Constant) and base.value == 16):
+        return False
+    return any(isinstance(sub, ast.Attribute) and sub.attr == "hexdigest"
+               for sub in ast.walk(call.args[0]))
+
+
+def _is_ring_bucketing(binop: ast.BinOp) -> bool:
+    """``point % num_vnodes`` / ``h // ring_size``: mod or floor-div
+    arithmetic with a vnode/ring-named operand — ring ownership math
+    deciding which replica a key lands on."""
+    if not isinstance(binop.op, (ast.FloorDiv, ast.Mod)):
+        return False
+    toks = ("vnode", "vnodes", "ring")
+    return _mentions_token(binop.left, toks) \
+        or _mentions_token(binop.right, toks)
+
+
 def check_source(src: str, filename: str = "<src>") -> List[str]:
     """Return ``"file:line: message"`` problems for one module's source."""
     problems: List[str] = []
@@ -500,6 +543,8 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     handload_scoped = norm.endswith(_HANDLOAD_SCOPE)
     # Rule 17 scope: everywhere, the fused lookup/sparse-grad home exempt
     embed_scoped = not norm.endswith(_EMBED_HOME)
+    # Rule 18 scope: everywhere, the ring/digest-scoring home exempt
+    affinity_scoped = not norm.endswith(_AFFINITY_HOME)
 
     def _allowed(lineno: int) -> bool:
         # marker anywhere on the offending line opts that line out
@@ -553,6 +598,10 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
     def _embed_allowed(lineno: int) -> bool:
         return (0 < lineno <= len(lines)
                 and _ALLOW_EMBED in lines[lineno - 1])
+
+    def _affinity_allowed(lineno: int) -> bool:
+        return (0 < lineno <= len(lines)
+                and _ALLOW_AFFINITY in lines[lineno - 1])
 
     if handload_scoped:
         # Rule 16, comprehension form: randrange/randint draws inside a
@@ -728,6 +777,24 @@ def check_source(src: str, filename: str = "<src>") -> List[str]:
                 "math lives in ONE home so every path agrees which chip "
                 "owns a row; route through embed.tables, or mark the "
                 f"line `{_ALLOW_EMBED}`)")
+        elif (isinstance(node, ast.Call) and affinity_scoped
+                and _is_ring_point(node)
+                and not _affinity_allowed(node.lineno)):
+            problems.append(
+                f"{filename}:{node.lineno}: hash-ring point minted "
+                f"inline (int(hexdigest, 16)) outside {_AFFINITY_HOME} "
+                "(placement keys the whole fleet must agree on; route "
+                "through affinity.ConsistentHashRing/score_digest, or "
+                f"mark the line `{_ALLOW_AFFINITY}`)")
+        elif (isinstance(node, ast.BinOp) and affinity_scoped
+                and _is_ring_bucketing(node)
+                and not _affinity_allowed(node.lineno)):
+            problems.append(
+                f"{filename}:{node.lineno}: vnode/ring bucketing "
+                f"arithmetic outside {_AFFINITY_HOME} (a private ring "
+                "assigns sessions differently than the router's; route "
+                "through affinity.ConsistentHashRing, or mark the line "
+                f"`{_ALLOW_AFFINITY}`)")
         elif (isinstance(node, ast.Call) and handload_scoped
                 and _is_handload_rng(node)
                 and not _handload_allowed(node.lineno)):

@@ -362,12 +362,43 @@ class Fleet:
         entry.ensure_apply()
         if warm_x is not None:
             rep.submit(name, warm_x)  # lint: allow-direct-replica
+        self._prewarm_prefixes(rep, name)
         if events.recording_enabled():
             events.emit("rollout", "warm", model=name,
                         version=entry.version, replica=rep.name,
                         warmed=warm_x is not None,
                         compile_cache_hits=entry.cache_hits,
                         compiles=entry.compile_count)
+
+    def _prewarm_prefixes(self, rep: InProcessReplica, name: str) -> None:
+        """Affinity pre-warm: before a swapped replica takes weight,
+        replay the fleet's hottest advertised prefix chains through its
+        prefill so the canary re-enters rotation already holding the KV
+        blocks the router will score it on — without this, every rollout
+        resets the replica to zero prefix-hit depth and the affinity
+        scorer correctly steers sessions away from the freshest code.
+        Best-effort on every axis: no affinity state, no hot prompts, or
+        a model without a generate lane all mean "skip", never "abort the
+        rollout"."""
+        aff = getattr(self.router, "affinity", None)
+        if aff is None:
+            return
+        limit = int(mmlconfig.get("fleet.affinity_prewarm"))
+        prompts = aff.hot_prompts(name, limit) if limit > 0 else []
+        if not prompts:
+            return
+        warmed = 0
+        for prompt in prompts:
+            try:
+                rep.server.submit_generate(
+                    name, prompt, max_new_tokens=1).result()
+                warmed += 1
+            except Exception:
+                continue    # one cold prompt is not a rollout failure
+        if events.recording_enabled():
+            events.emit("rollout", "prewarm", model=name,
+                        replica=rep.name, prompts=len(prompts),
+                        warmed=warmed)
 
     # -- lifecycle ----------------------------------------------------------
     def drain(self, reason: str = "drain") -> None:

@@ -243,6 +243,11 @@ class _Seq:
             "ttft_ms": round((self.ttft_s or 0.0) * 1e3, 3),
             "itl_mean_ms": round(sum(itl) / len(itl) * 1e3, 3) if itl
             else 0.0,
+            # prefix blocks shared at admission: the per-request ground
+            # truth the fleet bench sums into its hit rate — a router
+            # that CLAIMS affinity steered well is checked against what
+            # the replica's arena actually re-used
+            "prefix_hits": int(self.prefix_hits),
             "trace_id": self.trace_id,
         }
 

@@ -30,6 +30,10 @@ JSON in, JSON out, zero new dependencies — the transport half of
   rotates the replica out BEFORE it dies.
 - ``GET /models`` — registered model names (+ served versions).
 - ``GET /metrics`` — Prometheus text exposition of the process registry.
+- ``GET /affinity`` — prefix-digest advertisement: per generative model
+  the top-K resident KV prefix chains plus the hash parameters they were
+  keyed with, so a fleet scraper can score this replica by expected
+  prefix-hit depth without moving any KV bytes.
 
 ``ThreadingHTTPServer`` gives one thread per connection; they all funnel
 into the server's bounded queue, so concurrency is capped by admission
@@ -107,6 +111,30 @@ def make_handler(server: Server):
                 if hasattr(reg, "versions"):
                     payload["versions"] = reg.versions()
                 self._reply(200, payload)
+            elif self.path == "/affinity":
+                # prefix-digest advertisement: the metrics-adjacent JSON
+                # the fleet scraper pulls to score replicas by expected
+                # prefix-hit depth. Per generative model: the top-K
+                # resident chains plus the hashing parameters (kv dtype,
+                # block size) the chain keys were seeded with — scorers
+                # must hash prompts with the ADVERTISED params, never
+                # guess them.
+                tail = ".kv.resident_chains"
+                digests = {}
+                stats = server.stats()
+                for k, v in stats.items():
+                    if not (k.startswith("generate.") and k.endswith(tail)
+                            and isinstance(v, list)):
+                        continue
+                    model = k[len("generate."):-len(tail)]
+                    digests[model] = {
+                        "chains": v,
+                        "kv_dtype": str(stats.get(
+                            f"generate.{model}.kv.kv_dtype") or ""),
+                        "block_tokens": stats.get(
+                            f"generate.{model}.kv.block_tokens"),
+                    }
+                self._reply(200, {"digests": digests})
             elif self.path == "/metrics":
                 text = metrics.get_registry().prometheus_text()
                 body = text.encode("utf-8")

@@ -214,6 +214,11 @@ class KVCacheManager:
         self._block_hash: Dict[int, str] = {}
         self._cached: "OrderedDict[int, str]" = OrderedDict()
         self._meta: Dict[str, Dict[str, Any]] = {}
+        # per-hash chain metadata (parent link, registration depth, hit
+        # count, last-use tick) — the source of the top-K resident-chain
+        # summary replicas advertise for fleet prefix affinity
+        self._hmeta: Dict[str, Dict[str, Any]] = {}
+        self._tick = 0
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.cow_copies = 0
@@ -315,6 +320,7 @@ class KVCacheManager:
         h = self._block_hash.pop(block, None)
         if h is not None:
             self._index.pop(h, None)
+            self._hmeta.pop(h, None)
 
     def _take_fresh(self) -> Optional[int]:
         """One content-free block: the free list first, then the LRU
@@ -372,6 +378,14 @@ class KVCacheManager:
                 1 for b in self._cached if b not in matched)
             if reclaimable < fresh_needed:
                 return None                 # nothing mutated: clean shed
+            # hit heat only moves once the reservation is COMMITTED — a
+            # shed mutates nothing, including the digest's hit counters
+            self._tick += 1
+            for h in hashes[:m]:
+                hm = self._hmeta.get(h)
+                if hm is not None:
+                    hm["hits"] += 1
+                    hm["last_use"] = self._tick
             for b in shared:
                 self._bump(b)
             if cow_src is not None:
@@ -468,6 +482,7 @@ class KVCacheManager:
         indexed."""
         added = 0
         with self._lock:
+            self._tick += 1
             blocks = self._leases.get(seq_id, ())
             for i, h in enumerate(hashes):
                 if i >= len(blocks):
@@ -477,12 +492,61 @@ class KVCacheManager:
                     continue
                 self._index[h] = b
                 self._block_hash[b] = h
+                # parent link + depth make the chain walkable from its
+                # tail — what resident_chains() advertises fleet-wide
+                self._hmeta[h] = {
+                    "parent": hashes[i - 1] if i else None,
+                    "depth": i + 1, "hits": 0, "last_use": self._tick}
                 added += 1
         return added
 
     def block_refcount(self, block: int) -> int:
         with self._lock:
             return self._refcount.get(block, 0)
+
+    def resident_chains(self, top_k: int = 8) -> List[Dict[str, Any]]:
+        """Top-K summary of the resident prefix chains — the replica's
+        :class:`~mmlspark_tpu.serve.affinity.PrefixDigest` source.
+
+        A chain is a maximal run of indexed blocks whose WHOLE ancestor
+        line is still resident (a chain with an evicted ancestor can
+        never be matched by :meth:`try_reserve`, so it is not
+        advertised). Each entry carries the tail (deepest) hash, the
+        full walkable hash list, the depth in blocks, the tail block's
+        live lease count, the chain's hit count, and its last-use tick
+        (a monotonic reservation counter, not wall time). Ranked
+        hottest-first: (hits, last_use) descending.
+        """
+        if top_k <= 0:
+            return []
+        with self._lock:
+            resident = set(self._index)
+            parents = set()
+            for rh in resident:
+                hm = self._hmeta.get(rh)
+                if hm and hm.get("parent") in resident:
+                    parents.add(hm["parent"])
+            out: List[Dict[str, Any]] = []
+            for tail in resident - parents:
+                walk: List[str] = []
+                h: Optional[str] = tail
+                while h is not None and h in resident:
+                    walk.append(h)
+                    hm = self._hmeta.get(h)
+                    h = hm.get("parent") if hm else None
+                if h is not None:
+                    continue      # broken chain: an ancestor was evicted
+                walk.reverse()
+                hm = self._hmeta.get(tail) or {}
+                out.append({
+                    "chain": tail, "depth": len(walk), "hashes": walk,
+                    "leases": self._refcount.get(
+                        self._index.get(tail, -1), 0),
+                    "hits": int(hm.get("hits", 0)),
+                    "last_use": int(hm.get("last_use", 0))})
+            out.sort(key=lambda c: (-c["hits"], -c["last_use"],
+                                    -c["depth"], c["chain"]))
+            return out[:int(top_k)]
 
     # -- release -----------------------------------------------------------
     def free(self, seq_id: str) -> int:
@@ -585,10 +649,20 @@ class KVCacheManager:
         if scale_v is not None:
             self.scale_v = scale_v
 
-    def stats(self) -> Dict[str, float]:
+    def stats(self) -> Dict[str, Any]:
+        # the resident-chain digest rides the stats dict as a structured
+        # (non-numeric) value: the scraper's fleet totals and registry
+        # gauges skip it, the affinity layer picks it out by key
+        chains = self.resident_chains(
+            int(mmlconfig.get("generate.advertise_top_k")))
         with self._lock:
             used = len(self._refcount)
             return {
+                "resident_chains": chains,
+                # hash-seed params: a digest consumer re-derives the
+                # prompt's chain with the SAME (model, dtype, granule)
+                # seed, so advertise them next to the chains
+                "kv_dtype": self.dtype.name,
                 "blocks": self.num_blocks,
                 "block_tokens": self.block_tokens,
                 "used_blocks": used,

@@ -52,6 +52,7 @@ import numpy as np
 from mmlspark_tpu.observability import events, metrics
 from mmlspark_tpu.reliability.breaker import CircuitBreaker, CircuitOpen
 from mmlspark_tpu.reliability.retry import RetryPolicy
+from mmlspark_tpu.serve.affinity import AffinityHint, AffinityState
 from mmlspark_tpu.serve.server import (
     RequestExpired, ServeError, ServerClosed, ServerOverloaded,
     _mint_trace_id, _Twin,
@@ -227,7 +228,7 @@ class _Handle:
     smooth-WRR accumulator."""
 
     __slots__ = ("replica", "name", "weight", "current", "ready", "state",
-                 "breaker", "routed")
+                 "breaker", "routed", "inflight")
 
     def __init__(self, replica, breaker: CircuitBreaker):
         self.replica = replica
@@ -238,6 +239,7 @@ class _Handle:
         self.state = "unknown"
         self.breaker = breaker
         self.routed = metrics.Counter(f"fleet.routed.{self.name}")
+        self.inflight = 0           # requests inside _call_replica now
 
 
 class Router:
@@ -306,6 +308,12 @@ class Router:
         # process-wide metrics registry (chaos runs two in a row)
         self._failovers = _Twin("fleet.failovers")
         self._all_shed = _Twin("fleet.all_shed")
+        # prefix/session affinity for the generate lane (serve/affinity.py;
+        # docs/SERVING.md "fleet as one cache"). With no digests published
+        # yet (no scraper) and no session keys, picks reduce to pure WRR.
+        self.affinity: Optional[AffinityState] = (
+            AffinityState()
+            if bool(mmlconfig.get("fleet.affinity_enabled")) else None)
         self._prober: Optional[threading.Thread] = None
         self._prober_stop = threading.Event()
         # chaos sets this to a list: the router then appends the serving
@@ -349,6 +357,8 @@ class Router:
                 raise ValueError(
                     "cannot remove the last replica from the router")
             del self._handles[name]
+        if self.affinity is not None:
+            self.affinity.forget(name)
         if events.recording_enabled():
             events.emit("fleet", "remove_replica", replica=name)
 
@@ -372,21 +382,71 @@ class Router:
         warm restart so re-registration is immediate."""
         self._handles[name].breaker.reset()
 
-    def _pick(self, exclude: frozenset) -> Optional[_Handle]:
+    def _pick(self, exclude: frozenset,
+              hint: Optional[AffinityHint] = None) -> Optional[_Handle]:
         """Smooth weighted round-robin over ready, positive-weight,
         non-excluded replicas. Deterministic: same weights + same call
-        sequence = same spread (the chaos schedule depends on this)."""
+        sequence = same spread (the chaos schedule depends on this).
+
+        With an affinity ``hint``, the SAFE candidate set is first
+        narrowed by :meth:`AffinityState.select` — session stickiness,
+        then expected prefix-hit depth — and the WRR spread runs over
+        the narrowed pool (the tie-break). The safety filter above is
+        non-negotiable: affinity never resurrects an excluded, unready,
+        or zero-weight replica, and on failover the survivors are
+        re-scored with the dead replica in ``exclude``.
+
+        Overload overrides affinity (bounded load): when every replica
+        affinity picked is carrying more than
+        ``fleet.affinity_spill_factor`` times the candidate-mean
+        in-flight count (plus one — idle fleets never spill), the pick
+        SPILLS back to the full WRR pool. A warm cache is never worth a
+        hot spot, and a Zipf-heavy trace would otherwise convoy behind
+        the one replica that owns the hottest chain."""
+        mode, depth = "wrr", 0
         with self._lock:
             cands = [h for h in self._handles.values()
                      if h.ready and h.weight > 0 and h.name not in exclude]
-            if not cands:
-                return None
-            total = sum(h.weight for h in cands)
-            for h in cands:
+        if not cands:
+            return None
+        pool = cands
+        if hint is not None and self.affinity is not None:
+            names, mode, depth = self.affinity.select(
+                [h.name for h in cands], hint)
+            chosen = [h for h in cands if h.name in set(names)]
+            if chosen and mode != "wrr":
+                factor = float(mmlconfig.get("fleet.affinity_spill_factor"))
+                if factor > 0:
+                    with self._lock:
+                        cap = factor * (
+                            sum(h.inflight for h in cands) / len(cands) + 1)
+                        chosen = [h for h in chosen if h.inflight + 1 <= cap]
+                        if not chosen:
+                            # spill AWAY from the loaded leader, not back
+                            # onto it: the cool replica that absorbs this
+                            # miss caches the chain and advertises it —
+                            # hot chains grow replicas under pressure
+                            chosen = [h for h in cands
+                                      if h.inflight + 1 <= cap]
+                if not chosen:
+                    chosen = cands
+                    mode, depth = "wrr", 0
+                elif mode != "wrr" and not set(names) & {
+                        h.name for h in chosen}:
+                    mode, depth = "wrr", 0
+                if mode == "wrr":
+                    self.affinity.observe_spill()
+            if chosen:
+                pool = chosen
+        with self._lock:
+            total = sum(h.weight for h in pool)
+            for h in pool:
                 h.current += h.weight
-            best = max(cands, key=lambda h: (h.current, h.name))
+            best = max(pool, key=lambda h: (h.current, h.name))
             best.current -= total
-            return best
+        if hint is not None and self.affinity is not None:
+            self.affinity.observe_route(best.name, mode, depth)
+        return best
 
     # -- health ------------------------------------------------------------
     def probe(self) -> Dict[str, str]:
@@ -482,18 +542,27 @@ class Router:
                         seed: int = 0, eos_id: Optional[int] = None,
                         deadline_ms: Optional[float] = None,
                         tenant: str = "default",
+                        session: Optional[str] = None,
                         trace_id: Optional[str] = None) -> Dict:
         """Route one generation request with fleet semantics. Failover is
         a RESTART: generation state (KV pages, sampled tokens) dies with
         the replica, so the surviving replica replays the whole request
         from its prompt — and because sampling is seeded per (seed,
         position), the replayed stream is token-identical. Same
-        ``trace_id`` and the REMAINING deadline ride the retry."""
+        ``trace_id`` and the REMAINING deadline ride the retry.
+
+        Routing is prefix-affine (docs/SERVING.md "fleet as one
+        cache"): the prompt's block-hash chain is scored against every
+        READY replica's advertised digest, and a ``session`` key pins a
+        multi-turn conversation to one replica via the consistent-hash
+        ring — health, breakers, and overload always override both."""
         prompt = [int(t) for t in np.asarray(prompt).ravel()]
         trace_id = trace_id or _mint_trace_id()
         deadline = None
         if deadline_ms is not None and deadline_ms > 0:
             deadline = self.clock() + deadline_ms / 1e3
+        hint = self.affinity.hint_for(model, prompt, session) \
+            if self.affinity is not None else None
         self.fairness.admit(tenant, 1)
 
         def call(h: _Handle, remaining_ms: Optional[float]):
@@ -504,19 +573,21 @@ class Router:
 
         try:
             return self._route(model, call, trace_id, deadline,
-                               kind="generate")
+                               kind="generate", hint=hint)
         finally:
             self.fairness.release(tenant, 1)
 
     def _route(self, model: str, call: Callable, trace_id: str,
-               deadline: Optional[float], kind: str = "score"):
+               deadline: Optional[float], kind: str = "score",
+               hint: Optional[AffinityHint] = None):
         tried: set = set()
         sheds: List[Tuple[str, ServerOverloaded]] = []
         try:
             for attempt in self.failover_policy.attempts():
                 with attempt:
                     return self._route_once(model, call, trace_id,
-                                            deadline, tried, sheds, kind)
+                                            deadline, tried, sheds, kind,
+                                            hint)
         except _AllShed:
             pass  # consolidated below
         except (ReplicaUnavailable, CircuitOpen, ConnectionError) as e:
@@ -544,19 +615,22 @@ class Router:
     def _route_once(self, model: str, call: Callable, trace_id: str,
                     deadline: Optional[float], tried: set,
                     sheds: List[Tuple[str, ServerOverloaded]],
-                    kind: str = "score"):
+                    kind: str = "score",
+                    hint: Optional[AffinityHint] = None):
         """One routing attempt: offer the request to ready replicas in WRR
         order. A shed moves on to the next candidate in THIS attempt; a
         dead replica raises so the failover policy retries (a fresh
-        attempt, this replica excluded). ``call(handle, remaining_ms)``
-        performs the actual replica call — scoring and generation share
-        this whole routing/failover/shed machinery."""
+        attempt, this replica excluded — and, with an affinity hint, the
+        survivors re-scored by prefix depth so the warmest one wins the
+        restart). ``call(handle, remaining_ms)`` performs the actual
+        replica call — scoring and generation share this whole
+        routing/failover/shed machinery."""
         while True:
             if deadline is not None and self.clock() >= deadline:
                 raise RequestExpired(
                     f"deadline passed before a replica could answer "
                     f"(tried {sorted(tried)})")
-            h = self._pick(frozenset(tried))
+            h = self._pick(frozenset(tried), hint)
             if h is None:
                 if sheds:
                     raise _AllShed(sheds)
@@ -566,6 +640,8 @@ class Router:
             remaining_ms = None
             if deadline is not None:
                 remaining_ms = max((deadline - self.clock()) * 1e3, 0.001)
+            with self._lock:
+                h.inflight += 1     # the spill bound reads this
             try:
                 out = self._call_replica(h, call, remaining_ms)
             except ServerOverloaded as e:
@@ -592,6 +668,9 @@ class Router:
                 self._emit_failover(h, trace_id, e, kind)
                 tried.add(h.name)
                 raise
+            finally:
+                with self._lock:
+                    h.inflight -= 1
             h.routed.inc()
             if self.route_log is not None:
                 self.route_log.append(h.name)
@@ -689,10 +768,13 @@ class Router:
                             "state": h.state, "routed": h.routed.value,
                             "breaker": h.breaker.state}
                    for h in self._handles.values()}
-        return {"replicas": per,
-                "failovers": self._failovers.value,
-                "all_shed": self._all_shed.value,
-                "tenants": self.fairness.stats()}
+        out = {"replicas": per,
+               "failovers": self._failovers.value,
+               "all_shed": self._all_shed.value,
+               "tenants": self.fairness.stats()}
+        if self.affinity is not None:
+            out["affinity"] = self.affinity.stats()
+        return out
 
     def close(self) -> None:
         self.stop_prober()

@@ -103,6 +103,10 @@ _DEFAULTS: Dict[str, Any] = {
                                       # "" disables speculation
     "generate.spec_tokens": 3,        # draft tokens proposed+verified per
                                       # target step when draft_model is set
+    "generate.advertise_top_k": 8,    # resident prefix chains summarized
+                                      # into the replica's PrefixDigest
+                                      # (kvcache stats -> scraper -> router
+                                      # affinity; 0 disables advertisement)
     "generate.shard_kv": True,        # on a tensor-parallel model mesh,
                                       # shard the KV arena's head axis over
                                       # `tensor` (requires heads % |tensor|
@@ -137,6 +141,26 @@ _DEFAULTS: Dict[str, Any] = {
     "fleet.tenant_weights": "",       # "gold=3,free=1"; unlisted tenants
                                       # get fleet.tenant_default_weight
     "fleet.tenant_default_weight": 1.0,
+    # prefix-affinity routing (serve/affinity.py — see docs/SERVING.md
+    # "fleet as one cache"): replicas advertise their resident prefix
+    # chains; the router scores READY replicas by expected hit depth
+    # before the smooth-WRR tie-break. Breaker/overload/failover always
+    # override affinity — a cache hit is never worth a down replica.
+    "fleet.affinity_enabled": True,   # False = prefix-blind WRR only
+    "fleet.affinity_min_depth": 1,    # matched blocks required before
+                                      # prefix affinity overrides WRR
+    "fleet.affinity_vnodes": 64,      # virtual nodes per replica on the
+                                      # session consistent-hash ring
+    "fleet.affinity_seed": 0,         # ring placement seed (deterministic)
+    "fleet.affinity_prewarm": 4,      # hottest prompt prefixes replayed
+                                      # through a rollout canary's prefill
+                                      # before it takes weight (0 = off)
+    "fleet.affinity_spill_factor": 1.5,  # bounded load: an affinity
+                                      # leader whose in-flight count
+                                      # exceeds factor*(fleet mean + 1)
+                                      # spills the pick back to WRR — a
+                                      # cache hit is never worth a hot
+                                      # spot (0 = never spill)
     # process-fleet supervisor (serve/supervisor.py — real worker
     # processes with restart-on-crash; see docs/SERVING.md runbook)
     "fleet.supervisor_min_uptime_s": 5.0,   # a child dying sooner counts

@@ -1,0 +1,373 @@
+"""Prefix-affinity fleet routing (serve/affinity.py): make N replicas
+one KV cache.
+
+What this file pins down, layer by layer:
+
+- **Digest source** — :meth:`KVCacheManager.stats` advertises a bounded
+  top-K summary of the resident prefix chains (tail hash, walkable hash
+  list, depth, live lease count, hit heat, last-use tick).
+- **Scoring** — :func:`score_digest` returns the deepest advertised
+  chain position matching the request's hash chain, and 0 for a cold or
+  absent digest.
+- **Session ring** — :class:`ConsistentHashRing` is deterministic under
+  its seed and minimally disruptive under membership churn: keys not
+  owned by a removed replica never move.
+- **Safety** — affinity only ever narrows the router's SAFE candidate
+  set: a draining (not-ready), shedding, or already-tried replica is
+  never chosen to chase a cache hit, however deep its digest.
+- **Failover restart-from-prompt** — when the routed replica dies
+  mid-fleet, the retry re-scores the SURVIVORS by prefix depth, so the
+  restarted sequence lands on the warmest survivor and (seeded
+  sampling) replays a token-identical stream.
+"""
+import pytest
+
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.observability import metrics
+from mmlspark_tpu.observability.aggregate import FleetScraper
+from mmlspark_tpu.serve.affinity import (
+    AffinityState, ConsistentHashRing, PrefixDigest, score_digest,
+)
+from mmlspark_tpu.serve.fleet import Fleet
+from mmlspark_tpu.serve.kvcache import KVCacheManager, prefix_block_hashes
+from mmlspark_tpu.serve.router import Router
+from mmlspark_tpu.serve.server import Server, ServerOverloaded
+from mmlspark_tpu.utils import config
+
+_KEYS = ("generate.max_seq_len", "generate.max_sequences",
+         "generate.kv_block_tokens", "generate.prefix_cache",
+         "generate.advertise_top_k", "fleet.affinity_enabled",
+         "fleet.affinity_min_depth", "fleet.affinity_spill_factor",
+         "fleet.affinity_prewarm")
+
+
+@pytest.fixture(autouse=True)
+def _affinity_config():
+    prior = {k: config.get(k) for k in _KEYS}
+    config.set("generate.max_seq_len", 64)
+    config.set("generate.max_sequences", 4)
+    config.set("generate.kv_block_tokens", 8)
+    config.set("generate.prefix_cache", True)
+    config.set("generate.advertise_top_k", 8)
+    config.set("fleet.affinity_enabled", True)
+    config.set("fleet.affinity_min_depth", 1)
+    config.set("fleet.affinity_prewarm", 0)
+    metrics.get_registry().reset()
+    yield
+    for k, v in prior.items():
+        config.set(k, v)
+    metrics.get_registry().reset()
+
+
+def _hashes(prompt, bt=8, model="lm"):
+    return prefix_block_hashes(model, "float32", prompt, bt)
+
+
+def _digest(replica, chains, model="lm"):
+    return PrefixDigest(replica, model, chains, kv_dtype="float32",
+                        block_tokens=8)
+
+
+# -- kvcache: the advertised top-K resident-chain summary --------------------
+
+def test_kvcache_stats_summarizes_resident_chains():
+    kv = KVCacheManager(layers=2, heads=2, head_dim=4,
+                        num_blocks=16, block_tokens=8)
+    prompt = list(range(32))                       # 4 full blocks
+    h = _hashes(prompt)
+    kv.try_reserve("a", 40, prefix_hashes=h, prompt_tokens=32)
+    kv.register_prefix("a", h)
+    s = kv.stats()
+    chains = s["resident_chains"]
+    assert len(chains) == 1
+    c = chains[0]
+    assert c["chain"] == h[-1]                     # tail (deepest) hash
+    assert c["hashes"] == h                        # full walkable chain
+    assert c["depth"] == 4
+    assert c["leases"] == 1                        # "a" still holds it
+    assert c["last_use"] >= 1
+    # hash-seed params ride alongside so a consumer re-derives the same
+    # chain for scoring — guessing them would silently never match
+    assert s["kv_dtype"] == "float32"
+    assert s["block_tokens"] == 8
+
+    # a second sequence sharing the prefix bumps leases and hit heat
+    kv.try_reserve("b", 40, prefix_hashes=h, prompt_tokens=32)
+    c2 = kv.stats()["resident_chains"][0]
+    assert c2["leases"] == 2
+    assert c2["hits"] >= 1
+    assert c2["last_use"] > c["last_use"]
+
+    # freeing both leaves the chain resident (cached) with zero leases
+    kv.free("a")
+    kv.free("b")
+    c3 = kv.stats()["resident_chains"][0]
+    assert c3["depth"] == 4 and c3["leases"] == 0
+
+
+def test_kvcache_resident_chains_bounded_and_ranked():
+    kv = KVCacheManager(layers=2, heads=2, head_dim=4,
+                        num_blocks=32, block_tokens=8)
+    tails = []
+    for j in range(4):
+        prompt = [100 * j + t for t in range(16)]  # 2 full blocks each
+        h = _hashes(prompt)
+        kv.try_reserve(f"s{j}", 16, prefix_hashes=h, prompt_tokens=16)
+        kv.register_prefix(f"s{j}", h)
+        kv.free(f"s{j}")
+        tails.append(h[-1])
+    # re-reserve chain 2 twice: hit heat must rank it first
+    h2 = _hashes([200 + t for t in range(16)])
+    for sid in ("x", "y"):
+        kv.try_reserve(sid, 16, prefix_hashes=h2, prompt_tokens=16)
+        kv.free(sid)
+    top = kv.resident_chains(top_k=2)
+    assert len(top) == 2                           # bounded
+    assert top[0]["chain"] == tails[2]             # hottest first
+    assert kv.resident_chains(top_k=0) == []
+
+
+# -- score_digest ------------------------------------------------------------
+
+def test_score_digest_is_deepest_matched_position():
+    h = _hashes(list(range(32)))                   # depth-4 chain
+    d = _digest("r0", [{"chain": h[-1], "hashes": h, "depth": 4}])
+    assert score_digest(d, h) == 4                 # full match
+    assert score_digest(d, h[:2]) == 2             # prompt shorter
+    other = _hashes([9] * 32)
+    assert score_digest(d, other) == 0             # disjoint chain
+    assert score_digest(None, h) == 0              # no digest yet
+    assert score_digest(d, []) == 0                # no full blocks
+
+
+def test_score_digest_takes_best_across_chains():
+    deep = _hashes(list(range(32)))
+    shallow = _hashes(list(range(16)))
+    d = _digest("r0", [
+        {"chain": shallow[-1], "hashes": shallow, "depth": 2},
+        {"chain": deep[-1], "hashes": deep, "depth": 4},
+    ])
+    assert score_digest(d, deep) == 4
+
+
+# -- the session consistent-hash ring ----------------------------------------
+
+def test_ring_deterministic_under_seed():
+    names = [f"r{i}" for i in range(5)]
+    keys = [f"sess{i}" for i in range(200)]
+    a = ConsistentHashRing(names, vnodes=64, seed=7)
+    b = ConsistentHashRing(names, vnodes=64, seed=7)
+    assert [a.assign(k) for k in keys] == [b.assign(k) for k in keys]
+    c = ConsistentHashRing(names, vnodes=64, seed=8)
+    assert [a.assign(k) for k in keys] != [c.assign(k) for k in keys]
+
+
+def test_ring_membership_churn_is_minimal():
+    names = [f"r{i}" for i in range(4)]
+    keys = [f"sess{i}" for i in range(300)]
+    ring = ConsistentHashRing(names, vnodes=64, seed=0)
+    before = {k: ring.assign(k) for k in keys}
+
+    # retire r1: ONLY its keys may move
+    survivors = ConsistentHashRing([n for n in names if n != "r1"],
+                                   vnodes=64, seed=0)
+    for k in keys:
+        if before[k] != "r1":
+            assert survivors.assign(k) == before[k]
+
+    # add r4: keys keep their owner unless the new replica takes them
+    grown = ConsistentHashRing(names + ["r4"], vnodes=64, seed=0)
+    moved = 0
+    for k in keys:
+        after = grown.assign(k)
+        if after != before[k]:
+            assert after == "r4"                   # never a reshuffle
+            moved += 1
+    assert 0 < moved < len(keys) // 2              # bounded takeover
+
+
+# -- selection: affinity narrows, never overrides safety ---------------------
+
+def _state(**kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("min_depth", 1)
+    return AffinityState(**kw)
+
+
+def test_select_prefers_deepest_advertised_replica():
+    st = _state()
+    h = _hashes(list(range(32)))
+    st.update_digest("r0", "lm", [{"chain": h[1], "hashes": h[:2],
+                                   "depth": 2}],
+                     kv_dtype="float32", block_tokens=8)
+    st.update_digest("r1", "lm", [{"chain": h[-1], "hashes": h,
+                                   "depth": 4}],
+                     kv_dtype="float32", block_tokens=8)
+    hint = st.hint_for("lm", list(range(32)))
+    names, mode, depth = st.select(["r0", "r1", "r2"], hint)
+    assert (names, mode, depth) == (["r1"], "prefix", 4)
+
+
+def test_select_never_resurrects_an_excluded_replica():
+    # the router filters candidates BEFORE select: a breaker-open,
+    # draining, or already-tried replica simply is not in the list, and
+    # affinity must not fall back to it however deep its digest
+    st = _state()
+    h = _hashes(list(range(32)))
+    st.update_digest("rdown", "lm", [{"chain": h[-1], "hashes": h,
+                                      "depth": 4}],
+                     kv_dtype="float32", block_tokens=8)
+    hint = st.hint_for("lm", list(range(32)))
+    names, mode, depth = st.select(["r1", "r2"], hint)
+    assert "rdown" not in names
+    assert mode == "wrr" and depth == 0            # no survivor advertises
+
+    # session stickiness is ring-over-candidates, same property
+    hint_s = st.hint_for("lm", list(range(32)), session="sess1")
+    names_s, mode_s, _ = st.select(["r1", "r2"], hint_s)
+    assert mode_s == "session" and names_s[0] in ("r1", "r2")
+
+
+def test_select_cold_fleet_is_pure_wrr():
+    st = _state()
+    # no digest has ever arrived: hash params unknown, hint is None
+    assert st.hint_for("lm", list(range(32))) is None
+    hint = st.hint_for("lm", list(range(32)), session="s")
+    names, mode, depth = st.select(["r0", "r1"], hint)
+    assert mode == "session"                       # ring works digest-free
+
+
+# -- router integration: safety overrides affinity ---------------------------
+
+class GenFakeReplica:
+    """Replica-protocol fake with a scripted generate lane."""
+
+    def __init__(self, name, fail=None):
+        self.name = name
+        self.capacity_rows = 8
+        self.generate_calls = []
+        self.fail = list(fail or [])
+        self._health = {"live": True, "ready": True, "state": "ready"}
+
+    def submit_generate(self, model, prompt, max_new_tokens=None, **kw):
+        self.generate_calls.append(list(prompt))
+        if self.fail:
+            raise self.fail.pop(0)
+        return {"tokens": [1, 2], "replica": self.name}
+
+    def health(self):
+        return dict(self._health)
+
+    def models(self):
+        return ["lm"]
+
+
+def _router(*replicas, **kw):
+    kw.setdefault("failover_delay_s", 0.0)
+    kw.setdefault("sleep", lambda s: None)
+    return Router(list(replicas), **kw)
+
+
+def _advertise(router, replica, prompt, depth):
+    h = _hashes(prompt)[:depth]
+    router.affinity.update_digest(replica, "lm",
+                                  [{"chain": h[-1], "hashes": h,
+                                    "depth": depth}],
+                                  kv_dtype="float32", block_tokens=8)
+
+
+def test_router_steers_to_advertised_leader():
+    reps = [GenFakeReplica(f"r{i}") for i in range(3)]
+    r = _router(*reps)
+    prompt = list(range(32))
+    _advertise(r, "r2", prompt, 4)
+    for _ in range(4):
+        out = r.submit_generate("lm", prompt, 4)
+        assert out["replica"] == "r2"
+    assert r.affinity.stats()["routes_prefix"] == 4
+
+
+def test_router_affinity_never_picks_draining_replica():
+    reps = [GenFakeReplica(f"r{i}") for i in range(3)]
+    r = _router(*reps)
+    prompt = list(range(32))
+    _advertise(r, "r1", prompt, 4)
+    reps[1]._health = {"live": True, "ready": False, "state": "draining"}
+    r.probe()                                      # rotates r1 out
+    for _ in range(6):
+        assert r.submit_generate("lm", prompt, 4)["replica"] != "r1"
+    assert reps[1].generate_calls == []
+
+
+def test_router_affinity_never_retries_a_shedding_leader():
+    shedding = GenFakeReplica("r0", fail=[ServerOverloaded("full")] * 9)
+    other = GenFakeReplica("r1")
+    r = _router(shedding, other)
+    prompt = list(range(32))
+    _advertise(r, "r0", prompt, 4)
+    out = r.submit_generate("lm", prompt, 4)
+    assert out["replica"] == "r1"                  # shed -> next candidate
+    assert len(shedding.generate_calls) == 1       # offered exactly once
+
+
+def test_router_spills_off_an_overloaded_leader():
+    # bounded load: every copy of the leader over the in-flight cap
+    # sends the pick to the under-cap replicas — overload beats a hit
+    config.set("fleet.affinity_spill_factor", 1.5)
+    reps = [GenFakeReplica(f"r{i}") for i in range(3)]
+    r = _router(*reps)
+    prompt = list(range(32))
+    _advertise(r, "r0", prompt, 4)
+    with r._lock:
+        r._handles["r0"].inflight = 10             # deep queue on r0
+    out = r.submit_generate("lm", prompt, 4)
+    assert out["replica"] != "r0"
+    assert r.affinity.stats()["spills"] == 1
+    # back under the cap, affinity resumes
+    with r._lock:
+        r._handles["r0"].inflight = 0
+    assert r.submit_generate("lm", prompt, 4)["replica"] == "r0"
+
+
+# -- failover: restart-from-prompt lands on the warmest survivor -------------
+
+def make_lm(seed=0):
+    return JaxModel().set_model("transformer_lm_tiny", seed=seed)
+
+
+def test_failover_restarts_on_warmest_survivor_token_identical():
+    jm = make_lm()
+    prompt = list(range(32)) + [3, 4]              # 4 full blocks + tail
+
+    ref_srv = Server({"lm": jm})
+    try:
+        ref = ref_srv.submit_generate("lm", prompt, 6, seed=5).result()
+    finally:
+        ref_srv.close()
+
+    fleet = Fleet({"lm": jm}, replicas=3, failover_delay_s=0.0)
+    try:
+        # warm the chain DEEP on r0 and SHALLOW on r1 (only 2 of its 4
+        # blocks), leave r2 cold, then advertise via a real scrape
+        fleet.replicas[0].server.submit_generate(
+            "lm", prompt, 1, seed=5).result()
+        fleet.replicas[1].server.submit_generate(
+            "lm", prompt[:16] + [9], 1, seed=5).result()
+        FleetScraper(fleet).scrape()
+        aff = fleet.router.affinity
+        assert score_digest(aff.digest_for("r0", "lm"),
+                            _hashes(prompt)) == 4
+        assert score_digest(aff.digest_for("r1", "lm"),
+                            _hashes(prompt)) == 2
+
+        fleet.router.route_log = log = []
+        fleet.kill(0)                              # the leader dies
+        out = fleet.submit_generate("lm", prompt, 6, seed=5)
+    finally:
+        fleet.close()
+
+    # the retry re-scored the survivors: warmest (r1, depth 2) won the
+    # restart over cold r2, and the replayed stream is token-identical
+    assert log == ["r1"]
+    assert out["tokens"] == ref["tokens"]
+    assert fleet.router.stats()["failovers"] >= 1

@@ -856,3 +856,62 @@ def test_embed_rule_benign_arithmetic_not_flagged():
     """)
     assert lint.check_source(
         src, filename="mmlspark_tpu/models/custom.py") == []
+
+
+# -- rule 18: consistent-hash / digest-scoring arithmetic ---------------------
+
+def test_affinity_rule_flags_ring_points_and_vnode_bucketing():
+    src = textwrap.dedent("""
+        import hashlib
+
+        def place(names, key, vnodes):
+            points = [int(hashlib.sha256(n.encode()).hexdigest()[:16], 16)
+                      for n in names]
+            slot = hash(key) % vnodes
+            home = hash(key) // ring_span
+            return points, slot, home
+    """)
+    probs = lint.check_source(src, filename="mmlspark_tpu/serve/router.py")
+    assert len(probs) == 3
+    assert sum("hash-ring point" in p for p in probs) == 1
+    assert sum("bucketing" in p for p in probs) == 2
+    assert all("serve/affinity.py" in p for p in probs)  # sanctioned home
+    assert all("allow-affinity" in p for p in probs)     # escape hatch named
+
+
+def test_affinity_rule_home_exempt_and_marker_honored():
+    src = textwrap.dedent("""
+        import hashlib
+
+        def point(name, i, vnodes):
+            p = int(hashlib.sha256(f"{name}|{i}".encode())
+                    .hexdigest()[:16], 16)
+            return p % vnodes
+    """)
+    # the affinity home open-codes ring arithmetic freely
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/serve/affinity.py") == []
+    marked = textwrap.dedent("""
+        import hashlib
+
+        def point(name, vnodes):
+            p = int(hashlib.sha256(  # lint: allow-affinity
+                name.encode()).hexdigest()[:16], 16)
+            return p % vnodes  # lint: allow-affinity
+    """)
+    assert lint.check_source(
+        marked, filename="mmlspark_tpu/observability/aggregate.py") == []
+
+
+def test_affinity_rule_benign_int_parsing_not_flagged():
+    # int(x, 16) without a digest source, and //-% without ring words,
+    # are ordinary parsing and math
+    src = textwrap.dedent("""
+        def parse(text, width, count):
+            flags = int(text, 16)
+            rows = width // count
+            rem = width % count
+            return flags, rows, rem
+    """)
+    assert lint.check_source(
+        src, filename="mmlspark_tpu/serve/router.py") == []

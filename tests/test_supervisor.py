@@ -417,7 +417,8 @@ def test_chaos_scenario_registry_covers_all_runners():
     from mmlspark_tpu.reliability import chaos
     assert set(chaos.SCENARIOS) == {"train", "fleet", "decode", "host",
                                     "fleet_sharded", "decode_sharded",
-                                    "autopilot", "elastic", "recommender"}
+                                    "autopilot", "elastic", "recommender",
+                                    "fleetprefix"}
     assert all(desc for desc in chaos.SCENARIOS.values())
 
 
