@@ -2595,7 +2595,7 @@ def config_decode_fleetprefix() -> dict:
 # train_xl,decode_xl` line works on a laptop and on a real slice; on an
 # accelerator host the flag only touches the unused CPU platform.
 XL_DEVICES = 8
-XL_CONFIGS = ("train_xl", "decode_xl", "recommender")
+XL_CONFIGS = ("train_xl", "decode_xl", "recommender", "fleet_reshard")
 
 
 def _xl_mesh_or_skip():
@@ -3281,6 +3281,218 @@ def config_streaming_input():
 # the decode lane this round's gates ride on, then the MFU lane (the
 # machine-utilization evidence), then the cheap configs; the ResNet-50
 # featurizer (priciest setup) risks the squeeze, not the headline numbers.
+def config_fleet_reshard() -> dict:
+    """Elastic mesh, both halves (docs/PERFORMANCE.md "elastic mesh"):
+
+    **Serve** — an in-process fleet takes a seeded open-loop Poisson
+    stream on ONE wall-clock timeline while ``Fleet.reshard`` moves every
+    replica from the single-device placement onto the 2-D ``4x2`` mesh in
+    a background thread. Arrivals intended for the swap window pay the
+    wait as arrival latency — ``goodput`` / ``arrival_p99_ms`` (deadline
+    5 s, measured from INTENDED arrival, never clipped) are the honesty
+    axis, and ``steady_compiles`` counts compiles observed AFTER the
+    reshard finished: the in-swap ``warm_x`` pre-warm contract says 0.
+    The headline ``value`` is the delivery ratio through the whole cycle.
+
+    **Train** — the same move, training side, in 3-D:
+    ``ResilientTrainLoop.reshard_to`` drains a pipeline-parallel trainer
+    from the 1-D ``data=8`` mesh to the ``2x2x2`` ``(data, tensor,
+    pipe)`` topology mid-run; the resumed run's final loss must match the
+    uninterrupted 1-D reference (``train_loss_delta``). The model's Adam
+    state exceeds the emulated 48 MB per-chip budget while its
+    (pipe x tensor) shard fits — ``crosses_chip`` certifies the 3-D
+    placement does real work on the emulated 8-device mesh."""
+    import os
+    import tempfile
+    import threading
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.observability import memory as devmem
+    from mmlspark_tpu.observability.goodput import GoodputMeter
+    from mmlspark_tpu.parallel.checkpoint import TrainCheckpointer
+    from mmlspark_tpu.parallel.mesh import make_mesh, parse_mesh_shape
+    from mmlspark_tpu.parallel.pipeline_parallel import pipeline_apply
+    from mmlspark_tpu.parallel.sharding import pipeline_stacked_rules
+    from mmlspark_tpu.parallel.trainer import DistributedTrainer
+    from mmlspark_tpu.reliability.resilient import ResilientTrainLoop
+    from mmlspark_tpu.reliability.retry import RetryPolicy
+    from mmlspark_tpu.serve.fleet import Fleet
+    from mmlspark_tpu.testing import loadgen
+
+    shape_str, skip = _xl_mesh_or_skip()
+    if skip:
+        return skip
+    seed, replicas, dim = 12, 2, 8
+    mesh_to = shape_str                     # '4x2' on the 8-device mesh
+
+    # -- serve: open-loop fire through a live reshard ------------------------
+    model = JaxModel(inputCol="x", outputCol="y", miniBatchSize=8)
+    model.set_model("mlp_tabular", input_dim=dim, hidden=[16],
+                    num_classes=3, seed=seed)
+    schedule = loadgen.generate(
+        loadgen.Trace(duration_s=3.0, rate=8.0), seed)
+    requests = len(schedule)
+    stream = loadgen.feature_rows(requests, 2, dim, seed)
+    meter = GoodputMeter(deadline_s=5.0, bucket_s=1.0)
+    client = RetryPolicy(max_attempts=6, base_delay=0.2, max_delay=2.0,
+                         jitter=0.0, name="bench.reshard", seed=seed)
+    t0_box: list = []
+    served = 0
+    reshard_box: dict = {}
+    fleet = Fleet({"bench": model}, replicas=replicas,
+                  server_kwargs={"max_batch": 4, "queue_depth": 32})
+    t0 = _time.monotonic()
+    try:
+        def drive(chunk) -> int:
+            ok = 0
+            for a in chunk:
+                if t0_box:
+                    delay = (t0_box[0] + a.t) - _time.perf_counter()
+                    if delay > 0:
+                        _time.sleep(delay)
+                else:
+                    t0_box.append(_time.perf_counter() - a.t)
+                meter.offer(a.trace_id, a.t)
+                try:
+                    y = np.asarray(client.call(fleet.submit, "bench",
+                                               stream[a.index]))
+                except Exception:
+                    meter.shed(a.trace_id)
+                    continue
+                now = _time.perf_counter() - t0_box[0]
+                if y.shape[0] == 2:
+                    ok += 1
+                    meter.complete(a.trace_id, now)
+                else:
+                    meter.expire(a.trace_id)
+            return ok
+
+        def _reshard() -> None:
+            t = _time.monotonic()
+            try:
+                reshard_box["report"] = fleet.reshard(  # lint: allow-actuate
+                    mesh_to, warm_x=stream[0])
+            except Exception as e:
+                reshard_box["err"] = repr(e)
+            reshard_box["elapsed_s"] = _time.monotonic() - t
+
+        third = requests // 3
+        served += drive(schedule[:third])           # old placement
+        rt = threading.Thread(target=_reshard, daemon=True,
+                              name="bench-fleet-reshard")
+        rt.start()
+        served += drive(schedule[third:2 * third])  # THROUGH the swaps
+        rt.join(120)
+        compiles_after = sum(
+            r.server.registry.get("bench").compile_count
+            for r in fleet.replicas)
+        served += drive(schedule[2 * third:])       # new placement
+        steady_compiles = sum(
+            r.server.registry.get("bench").compile_count
+            for r in fleet.replicas) - compiles_after
+        elapsed = _time.monotonic() - t0
+        resharded = reshard_box.get("report", {}).get("resharded", 0)
+    finally:
+        fleet.close()
+    wl = meter.result()
+
+    # -- train: 1-D -> 3-D reshard_to, loss-matched --------------------------
+    d, hidden, stages, bs, steps = 1024, 2048, 2, 16, 6
+    chip_budget_mb = 48.0
+    rng_np = np.random.default_rng(seed)
+    host = {"stages": {
+                "mlp_up_kernel": rng_np.normal(
+                    0, 0.02, (stages, d, hidden)).astype(np.float32),
+                "mlp_down_kernel": rng_np.normal(
+                    0, 0.02, (stages, hidden, d)).astype(np.float32)},
+            "head_kernel": rng_np.normal(
+                0, 0.02, (d, 1)).astype(np.float32)}
+
+    def init_params():
+        return jax.tree_util.tree_map(jnp.asarray, host)
+
+    def batch_fn(step: int) -> dict:
+        r = np.random.default_rng(1000 + step)
+        x = r.normal(0, 1, (bs, d)).astype(np.float32)
+        return {"x": x, "y": (x[:, 0] * 0.5).astype(np.float32)}
+
+    def factory(mesh):
+        def loss_fn(params, batch, rng):
+            h = pipeline_apply(
+                lambda p, x: x + jnp.tanh(x @ p["mlp_up_kernel"])
+                @ p["mlp_down_kernel"],
+                params["stages"], batch["x"], mesh, n_microbatches=2)
+            pred = (h @ params["head_kernel"])[:, 0]
+            return ((pred - batch["y"]) ** 2).mean()
+
+        # small lr: adam's per-coordinate steps are coherent over d=1024
+        # dims, so anything larger oscillates and the loss comparison
+        # would compare two divergences instead of two training runs
+        return DistributedTrainer(loss_fn, optax.adam(1e-4), mesh=mesh,
+                                  rules=pipeline_stacked_rules())
+
+    def host_eval_loss(state) -> float:
+        p = jax.device_get(state["params"])
+        b = batch_fn(9999)
+        h = b["x"]
+        for s in range(stages):
+            h = h + np.tanh(h @ p["stages"]["mlp_up_kernel"][s]) \
+                @ p["stages"]["mlp_down_kernel"][s]
+        pred = (h @ p["head_kernel"])[:, 0]
+        return float(((pred - b["y"]) ** 2).mean())
+
+    with tempfile.TemporaryDirectory(prefix="bench_reshard_") as tmp:
+        ck_ref = TrainCheckpointer(os.path.join(tmp, "ref"))
+        ref_loop = ResilientTrainLoop(
+            factory(make_mesh(parse_mesh_shape("8"))), ck_ref,
+            init_params, save_every=2, trainer_factory=factory)
+        s_ref = ref_loop.run(batch_fn, steps)
+        ck_ref.close()
+
+        ck_r = TrainCheckpointer(os.path.join(tmp, "reshard"))
+        loop = ResilientTrainLoop(
+            factory(make_mesh(parse_mesh_shape("8"))), ck_r,
+            init_params, save_every=2, trainer_factory=factory)
+        loop.reshard_to("2x2x2")  # lint: allow-actuate
+        s_3d = loop.run(batch_fn, steps)
+        ck_r.close()
+
+    l_ref = host_eval_loss(s_ref)
+    l_3d = host_eval_loss(s_3d)
+    state_mb = devmem.param_bytes(s_3d) / 1e6
+    shard_mb = devmem.param_shard_bytes(s_3d) / 1e6
+
+    return {"value": round(served / requests, 4),
+            "unit": "delivery ratio",
+            # perfect delivery IS the baseline: every request the static
+            # placement would have served, served through the reshard
+            "vs_baseline": round(served / requests, 4),
+            "goodput": wl["goodput"],
+            "arrival_p99_ms": wl["arrival_p99_ms"],
+            "deadline_ms": wl["deadline_ms"],
+            "offered_qps": wl["offered_qps"],
+            "delivered_qps": wl["delivered_qps"],
+            "steady_compiles": int(steady_compiles),
+            "reshard_s": round(reshard_box.get("elapsed_s", 0.0), 3),
+            "resharded_replicas": int(resharded),
+            "mesh_to": mesh_to,
+            "train_mesh_3d": "2x2x2",
+            "train_loss_ref": round(l_ref, 6),
+            "train_loss_resharded": round(l_3d, 6),
+            "train_loss_delta": round(abs(l_ref - l_3d), 6),
+            "state_bytes_mb": round(state_mb, 1),
+            "shard_bytes_mb": round(shard_mb, 1),
+            "chip_budget_mb": chip_budget_mb,
+            "crosses_chip": bool(state_mb > chip_budget_mb >= shard_mb),
+            "replicas": replicas, "requests": requests,
+            "elapsed_s": round(elapsed, 2)}
+
+
 CONFIGS = {
     "train": config_train,
     "decode_sharedprefix": config_decode_sharedprefix,
@@ -3300,6 +3512,7 @@ CONFIGS = {
     "decode_xl": config_decode_xl,
     "recommender": config_recommender,
     "streaming_input": config_streaming_input,
+    "fleet_reshard": config_fleet_reshard,
 }
 
 # units for the zero-configs-completed stub line (the normal path takes
@@ -3318,6 +3531,7 @@ CONFIG_UNITS = {
     "decode_xl": "tokens/sec/chip",
     "recommender": "rows/sec/chip",
     "streaming_input": "rows/sec",
+    "fleet_reshard": "delivery ratio",
 }
 
 

@@ -774,10 +774,16 @@ def cmd_chaos(args, passthrough) -> int:
     cache"); zero failed requests, survivors absorb the session keys,
     tokens bit-identical to a single server, and the prefix hit rate
     recovers with zero new compiles.
+    ``--scenario reshard``: SIGKILL a replica MID-RESHARD while the
+    fleet moves onto a new mesh placement under fire; zero failed
+    requests, scores bit-identical to an untouched reference on both
+    placements, survivors finish the reshard, and the HBM ledger
+    reconciles to zero on close.
     Writes ``chaos_verdict.json`` under --out; exit 0 iff every
     invariant held."""
     if (args.scenario.endswith("_sharded")
-            or args.scenario == "recommender") and "jax" not in sys.modules:
+            or args.scenario in ("recommender", "reshard")) \
+            and "jax" not in sys.modules:
         # the 2-D mesh needs >= 4 devices: raise the host-platform count
         # BEFORE jax first loads so a CPU-only host can form it (same
         # seam as bench.py's xl lanes; on accelerator hosts the flag
@@ -826,6 +832,10 @@ def cmd_chaos(args, passthrough) -> int:
             requests=args.requests)
     elif args.scenario == "fleetprefix":
         verdict = chaos.run_fleetprefix_scenario(
+            args.seed, outdir, replicas=args.replicas,
+            requests=args.requests)
+    elif args.scenario == "reshard":
+        verdict = chaos.run_reshard_scenario(
             args.seed, outdir, replicas=args.replicas,
             requests=args.requests)
     else:

@@ -134,14 +134,21 @@ def device_fingerprint() -> str:
 
 
 def entry_key(model: str, version: str, bucket: int,
-              row_shape: Tuple[int, ...], dtype: str) -> str:
+              row_shape: Tuple[int, ...], dtype: str,
+              mesh_key: str = "") -> str:
     """Filename stem for one program: the model+shape identity. The
     environment (jax/device fingerprint) lives in the header, not the
     name, so a toolchain bump is a *detected* stale entry, not a silent
-    cache miss that leaves garbage behind."""
-    ident = "\x00".join([model, version, str(int(bucket)),
-                         ",".join(str(int(d)) for d in row_shape),
-                         str(dtype)])
+    cache miss that leaves garbage behind. ``mesh_key`` is the placement
+    identity ('' for single-device): an elastic reshard serves the same
+    model+version under DIFFERENT mesh placements, and their partitioned
+    executables must coexist, never collide (the score-path twin of the
+    generative lane's ``|mesh=`` shape_key suffix)."""
+    parts = [model, version, str(int(bucket)),
+             ",".join(str(int(d)) for d in row_shape), str(dtype)]
+    if mesh_key:
+        parts.append(f"mesh={mesh_key}")
+    ident = "\x00".join(parts)
     return hashlib.sha256(ident.encode("utf-8")).hexdigest()[:40]
 
 
@@ -295,7 +302,7 @@ def _cached_compile(stem: str, meta: Dict[str, Any],
 
 def load_or_compile(model: str, version: str, bucket: int,
                     row_shape: Tuple[int, ...], dtype: Any,
-                    jitted, params) -> CacheResult:
+                    jitted, params, mesh_key: str = "") -> CacheResult:
     """The serve-side compile seam: return the AOT executable for one
     padded bucket shape, loading it from ``runtime.compile_cache_dir``
     when a verified entry exists and compiling (then storing) otherwise.
@@ -304,6 +311,9 @@ def load_or_compile(model: str, version: str, bucket: int,
     ``params`` its device-resident tree — the compile itself happens HERE
     so serve/ modules never spell ``lower().compile()`` (lint Rule 9).
     The returned program is called as ``program(params, x)``.
+    ``mesh_key`` carries the placement identity for mesh-bound models
+    (see :func:`entry_key`) so resharded placements get their own
+    entries.
     """
     import jax
     import numpy as np
@@ -313,12 +323,15 @@ def load_or_compile(model: str, version: str, bucket: int,
     meta = {"model": model, "version": version, "bucket": int(bucket),
             "row_shape": list(int(d) for d in row_shape),
             "dtype": dtype_name}
+    if mesh_key:
+        meta["mesh"] = mesh_key
 
     def fresh() -> Callable:
         return jitted.lower(params, spec).compile()
 
     return _cached_compile(
-        entry_key(model, version, bucket, tuple(row_shape), dtype_name),
+        entry_key(model, version, bucket, tuple(row_shape), dtype_name,
+                  mesh_key),
         meta, fresh)
 
 

@@ -12,13 +12,16 @@ the SRE Workbook's multi-window burn-rate alerts:
 - **Decide**: :func:`decide` is a PURE function of ``(signals, policy,
   state)`` — no clock reads, no I/O, no mutation — so every decision is
   unit-testable as a table row and replayable from its event payload.
-- **Actuate** four levers: per-replica traffic shift (ramp
+- **Actuate** five levers: per-replica traffic shift (ramp
   ``Router.set_weight`` down on an error-rate outlier, back on
   recovery), replica scale up/down through ``Fleet`` (bounded by
   ``autopilot.{min,max}_replicas`` and HBM headroom), adaptive admission
   (tighten/relax the ``WeightedFairAdmission`` fleet quota under
-  fast-window burn), and the rollout guard (abort ``Fleet.rollout`` when
-  the canary burns).
+  fast-window burn), the rollout guard (abort ``Fleet.rollout`` when
+  the canary burns), and — PR 20 — the elastic mesh
+  (``Fleet.reshard``: widen the tensor axis under HBM-ledger pressure,
+  narrow it when queue depth wants replicas the scale lever can no
+  longer add; ``autopilot.reshard_*`` keys).
 - **Hysteresis is part of the decision core**, not an afterthought:
   separate up/down thresholds per lever, per-lever cooldowns keyed so a
   reversal (A -> B -> A) cannot happen inside one cooldown window, and a
@@ -70,6 +73,17 @@ class AutopilotPolicy:
     admission_floor_frac: float = 0.25
     admission_relax_burn: float = 1.0
     admission_cooldown_s: float = 25.0
+    # fifth lever — elastic mesh: the target placements ('' disables the
+    # direction). ``reshard_wide`` is the wider-tensor-axis shape taken
+    # under HBM-ledger pressure (per-chip bytes shrink as the tensor axis
+    # grows); ``reshard_narrow`` the narrower shape taken when queue
+    # depth wants replicas the scale lever can no longer add. Both
+    # directions share one cooldown key, so wide -> narrow -> wide
+    # cannot flap inside a window (structural hysteresis, like scale).
+    reshard_wide: str = ""
+    reshard_narrow: str = ""
+    reshard_hbm_frac: float = 0.85
+    reshard_cooldown_s: float = 60.0
     window_s: float = 120.0
     max_actions_per_window: int = 8
 
@@ -88,6 +102,13 @@ class AutopilotPolicy:
                              "(hysteresis band)")
         if not (0.0 < self.admission_factor < 1.0):
             raise ValueError("admission_factor must be in (0, 1)")
+        if not (0.0 < self.reshard_hbm_frac <= 1.0):
+            raise ValueError(
+                "reshard_hbm_frac must be in (0, 1]")
+        if self.reshard_wide and self.reshard_wide == self.reshard_narrow:
+            raise ValueError(
+                "reshard_wide and reshard_narrow must name DIFFERENT "
+                "shapes (the gap is the hysteresis band)")
 
     @classmethod
     def from_config(cls, **overrides) -> "AutopilotPolicy":
@@ -118,12 +139,13 @@ class AutopilotState:
 
 
 def cooldown_key(lever: str, target: str) -> str:
-    """Cooldown bucket for one decision. Scale is fleet-level (up and
-    down share one key so an up cannot chase a down inside the
-    cooldown); shift and everything replica-scoped key per target for
-    the same reason — both directions of a lever share its key, which
-    is what makes the no-flap property structural."""
-    return lever if lever in ("scale", "admission") else \
+    """Cooldown bucket for one decision. Scale, admission, and reshard
+    are fleet-level (up and down — or wide and narrow — share one key so
+    one direction cannot chase the other inside the cooldown); shift and
+    everything replica-scoped key per target for the same reason — both
+    directions of a lever share its key, which is what makes the no-flap
+    property structural."""
+    return lever if lever in ("scale", "admission", "reshard") else \
         f"{lever}:{target}"
 
 
@@ -291,6 +313,45 @@ def decide(signals: Dict[str, Any], policy: AutopilotPolicy,
                  policy.admission_cooldown_s,
                  new_capacity=new_cap, **adm_payload)
 
+    # -- lever 5: elastic mesh (Fleet.reshard) ---------------------------
+    # Wide under HBM-ledger pressure (a wider tensor axis shrinks every
+    # chip's resident shard); narrow when queue depth wants replicas the
+    # scale lever is vetoed from adding. The two triggers are disjoint
+    # pressure regimes and both directions share the "reshard" cooldown
+    # key, so the controller cannot oscillate placements.
+    cur_shape = str(signals.get("mesh", {}).get("shape", ""))
+    if policy.reshard_wide or policy.reshard_narrow:
+        total_reps = len(replicas)
+        mesh_payload = dict(mesh_shape=cur_shape,
+                            hbm_bytes=int(hbm), live=live,
+                            queue_mean=round(mean_q, 3))
+        hbm_pressure = (policy.hbm_limit_bytes > 0
+                        and hbm >= policy.reshard_hbm_frac
+                        * policy.hbm_limit_bytes)
+        queue_pressure = (want_up
+                          and total_reps >= policy.max_replicas)
+        if hbm_pressure and policy.reshard_wide:
+            reason = (f"hbm {int(hbm)} >= {policy.reshard_hbm_frac:.2f}"
+                      f" * limit {policy.hbm_limit_bytes}")
+            if cur_shape == policy.reshard_wide:
+                veto("reshard", "reshard_wide", policy.reshard_wide,
+                     f"bounds:at_target ({cur_shape!r}; wanted: "
+                     f"{reason})", **mesh_payload)
+            else:
+                push("reshard", "reshard_wide", policy.reshard_wide,
+                     reason, policy.reshard_cooldown_s, **mesh_payload)
+        elif queue_pressure and policy.reshard_narrow:
+            reason = (f"queue wants replicas past max "
+                      f"{policy.max_replicas} (mean queue {mean_q:.1f};"
+                      f" wanted: {up_reason})")
+            if cur_shape == policy.reshard_narrow:
+                veto("reshard", "reshard_narrow", policy.reshard_narrow,
+                     f"bounds:at_target ({cur_shape!r}; wanted: "
+                     f"{reason})", **mesh_payload)
+            else:
+                push("reshard", "reshard_narrow", policy.reshard_narrow,
+                     reason, policy.reshard_cooldown_s, **mesh_payload)
+
     return decisions
 
 
@@ -298,7 +359,8 @@ def fleet_signals(snap: Dict[str, Any],
                   slo_status: List[Dict[str, Any]],
                   router_stats: Dict[str, Any],
                   now: float, *,
-                  admission: Optional[Dict[str, int]] = None
+                  admission: Optional[Dict[str, int]] = None,
+                  mesh_shape: Optional[str] = None
                   ) -> Dict[str, Any]:
     """Distill one scraper snapshot + SLO observation + router stats into
     the flat signal dict :func:`decide` consumes::
@@ -308,7 +370,8 @@ def fleet_signals(snap: Dict[str, Any],
                              completed, failed, shed}},
          "slo": {"burning": bool, "breaching": bool, "burn_fast": max},
          "memory": {"total_bytes": int},
-         "admission": {"capacity_rows": int, "baseline_rows": int}}
+         "admission": {"capacity_rows": int, "baseline_rows": int},
+         "mesh": {"shape": "4x2"}}
 
     Readiness comes from the scrape (health truth), weight from the
     router (rotation truth) — the two sides of "is this replica taking
@@ -343,6 +406,8 @@ def fleet_signals(snap: Dict[str, Any],
     }
     if admission:
         sig["admission"] = dict(admission)
+    if mesh_shape is not None:
+        sig["mesh"] = {"shape": str(mesh_shape)}
     return sig
 
 
@@ -438,7 +503,8 @@ class Autopilot:
             admission={"capacity_rows": int(fairness.capacity_rows),
                        "baseline_rows": int(getattr(
                            fairness, "baseline_rows",
-                           fairness.capacity_rows))})
+                           fairness.capacity_rows))},
+            mesh_shape=str(getattr(self.fleet, "mesh_shape", "")))
         self._emit_signals(sig)
         decisions = decide(sig, self.policy, self.state)
         for d in decisions:
@@ -461,6 +527,8 @@ class Autopilot:
             elif action == "admission_tighten" \
                     or action == "admission_relax":
                 self.router.fairness.set_capacity(d["new_capacity"])
+            elif action in ("reshard_wide", "reshard_narrow"):
+                d["report"] = self.fleet.reshard(d["target"])
             else:  # pragma: no cover - decide() and _actuate in lockstep
                 raise ValueError(f"unknown action {action!r}")
         except Exception as e:

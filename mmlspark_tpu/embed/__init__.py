@@ -11,8 +11,8 @@ tables back ``DistributedTrainer.fit`` training AND online fleet
 scoring through ``serve/``.
 """
 from mmlspark_tpu.embed.tables import (EmbeddingCollection, EmbeddingTable,
-                                       bag_lookup_reference,
+                                       RowResidency, bag_lookup_reference,
                                        make_bag_lookup, sparse_table_grads)
 
-__all__ = ["EmbeddingCollection", "EmbeddingTable", "bag_lookup_reference",
-           "make_bag_lookup", "sparse_table_grads"]
+__all__ = ["EmbeddingCollection", "EmbeddingTable", "RowResidency",
+           "bag_lookup_reference", "make_bag_lookup", "sparse_table_grads"]

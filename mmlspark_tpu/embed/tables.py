@@ -348,3 +348,128 @@ class EmbeddingCollection:
         itemsize = jnp.zeros((), self.dtype).dtype.itemsize
         return sum(t.padded_rows(self.mesh) * t.dim * itemsize
                    for t in self.tables.values())
+
+
+class RowResidency:
+    """Frequency-capped per-row hot pool over one host master table.
+
+    PR 17's eviction is whole-table: the registry LRU drops a model's
+    ENTIRE ``kind="table"`` ledger line when the warm set overflows
+    ``runtime.device_cache_mb``. That is the right lever when the table
+    fits one budget slot, and the wrong one when it doesn't — a table
+    that is 10x the budget can still serve from residency because real
+    id traffic is Zipfian: a small hot set covers most lookups. This
+    pool is the per-row refinement: a bounded pool of hot rows over a
+    host master, admitting rows on first touch and evicting the
+    COLDEST rows first when full.
+
+    "Frequency-capped": each resident row keeps an access counter
+    capped at ``freq_cap``; eviction victims sort by
+    ``(capped_frequency, last_touch)`` ascending — cold-and-stale rows
+    go first. The cap bounds how long a HISTORICALLY hot row can
+    outrank a NEWLY hot one: past ~``freq_cap`` touches every hot row
+    looks equally hot and recency breaks the tie, so a shifted working
+    set turns the pool over in O(capacity) admissions instead of never
+    (the classic uncapped-LFU failure).
+
+    Ledger contract (the PR 17 invariant, kept at row granularity):
+    resident bytes are re-published to the process ledger as
+    ``kind="table"`` under ``model`` after every admit/evict, and
+    :meth:`close` frees the pool and reconciles the line to ZERO.
+    Lookups are bit-identical to indexing the master directly — rows
+    are admitted by copy, never transformed.
+    """
+
+    def __init__(self, model: str, master: np.ndarray,
+                 capacity_rows: int, freq_cap: int = 15, ledger=None):
+        if capacity_rows <= 0:
+            raise ValueError(f"capacity_rows must be > 0, got "
+                             f"{capacity_rows}")
+        if freq_cap <= 0:
+            raise ValueError(f"freq_cap must be > 0, got {freq_cap}")
+        from mmlspark_tpu.observability import memory as devmem
+        self.model = str(model)
+        self._master = master
+        self._cap = int(capacity_rows)
+        self._freq_cap = int(freq_cap)
+        self._ledger = ledger if ledger is not None else devmem.get_ledger()
+        self._row_bytes = devmem.nbytes_of(master.shape[1:], master.dtype)
+        self._pool = np.zeros((self._cap,) + master.shape[1:], master.dtype)
+        self._slot: Dict[int, int] = {}      # id -> pool slot
+        self._freq: Dict[int, int] = {}      # id -> capped touch count
+        self._touch: Dict[int, int] = {}     # id -> logical tick
+        self._free = list(range(self._cap - 1, -1, -1))
+        self._tick = 0
+        self._closed = False
+        self.evictions = 0
+        self.misses = 0
+        self.hits = 0
+        self._charge()
+
+    def _charge(self) -> None:
+        self._ledger.set_bytes(self.model, "table",
+                               len(self._slot) * self._row_bytes)
+
+    def _evict_cold(self, n: int) -> None:
+        # coldest first: lowest capped frequency, then stalest touch —
+        # deterministic id tiebreak so two runs evict identically
+        victims = sorted(self._slot,
+                         key=lambda i: (self._freq[i], self._touch[i], i))
+        for rid in victims[:n]:
+            self._free.append(self._slot.pop(rid))
+            del self._freq[rid], self._touch[rid]
+            self.evictions += 1
+
+    def lookup(self, ids: Sequence[int]) -> np.ndarray:
+        """Rows for ``ids`` (host-order, bit-identical to
+        ``master[ids]``), touching/admitting each id through the pool."""
+        if self._closed:
+            raise RuntimeError(f"RowResidency {self.model!r} is closed")
+        out = np.empty((len(ids),) + self._master.shape[1:],
+                       self._master.dtype)
+        for j, rid in enumerate(ids):
+            rid = int(rid)
+            self._tick += 1
+            slot = self._slot.get(rid)
+            if slot is None:
+                self.misses += 1
+                if not self._free:
+                    self._evict_cold(1)
+                slot = self._free.pop()
+                self._pool[slot] = self._master[rid]
+                self._slot[rid] = slot
+                self._freq[rid] = 1
+            else:
+                self.hits += 1
+                self._freq[rid] = min(self._freq[rid] + 1, self._freq_cap)
+            self._touch[rid] = self._tick
+            out[j] = self._pool[slot]
+        self._charge()
+        return out
+
+    # -- observability -------------------------------------------------------
+    @property
+    def resident_rows(self) -> int:
+        return len(self._slot)
+
+    def resident_bytes(self) -> int:
+        return len(self._slot) * self._row_bytes
+
+    def stats(self) -> Dict[str, int]:
+        return {"resident_rows": len(self._slot),
+                "capacity_rows": self._cap,
+                "resident_bytes": self.resident_bytes(),
+                "hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions}
+
+    def close(self) -> None:
+        """Free the pool and reconcile the ledger line to ZERO — same
+        close contract as a registry eviction, at row granularity."""
+        if self._closed:
+            return
+        self._closed = True
+        self._slot.clear()
+        self._freq.clear()
+        self._touch.clear()
+        self._free = list(range(self._cap - 1, -1, -1))
+        self._charge()

@@ -92,6 +92,34 @@ def param_shard_bytes(params: Any) -> int:
                for l in jax.tree_util.tree_leaves(params))
 
 
+def projected_shard_bytes(params: Any, mesh: Any = None,
+                          rules: Any = None) -> int:
+    """Per-device bytes a HOST param tree WOULD pin once placed on
+    ``mesh`` under :func:`~mmlspark_tpu.parallel.sharding.param_shardings`
+    — computed from shapes alone, with NOTHING materialized on device.
+    ``mesh=None`` means the single-device path (full logical bytes). The
+    registry's ``replace`` pre-check uses this to refuse a placement that
+    cannot fit the ``runtime.device_cache_mb`` budget BEFORE it drops the
+    entry it would displace."""
+    if params is None:
+        return 0
+    if mesh is None:
+        return param_bytes(params)
+    import jax
+    from mmlspark_tpu.parallel.sharding import param_shardings
+    shardings = param_shardings(params, mesh, rules)
+    total = 0
+    for leaf, sh in zip(jax.tree_util.tree_leaves(params),
+                        jax.tree_util.tree_leaves(shardings)):
+        leaf = np.asarray(leaf) if not hasattr(leaf, "shape") else leaf
+        try:
+            total += nbytes_of(sh.shard_shape(tuple(leaf.shape)),
+                               leaf.dtype)
+        except (TypeError, ValueError):
+            total += nbytes_of(leaf.shape, leaf.dtype)
+    return total
+
+
 def split_param_shard_bytes(params: Any) -> Tuple[int, int]:
     """Per-device resident bytes of a param tree SPLIT into
     ``(dense_bytes, table_bytes)``: leaves whose '/'-joined path matches

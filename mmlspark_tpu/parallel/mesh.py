@@ -95,16 +95,19 @@ def parse_mesh_axes(text: str) -> Dict[str, int]:
 
 
 def parse_mesh_shape(text: str) -> MeshSpec:
-    """'4x2' -> MeshSpec(data=4, tensor=2): the 2-D (data, model) shorthand
-    behind the ``parallel.mesh_shape`` config key. The first factor is the
-    data axis (-1 absorbs remaining devices), the second the model
-    (``tensor``) axis — placed last so per-layer collectives ride the
-    innermost ICI ring. A single factor ('8') means pure data parallel."""
+    """'4x2' -> MeshSpec(data=4, tensor=2): the (data, model[, pipe])
+    shorthand behind the ``parallel.mesh_shape`` config key. The first
+    factor is the data axis (-1 absorbs remaining devices), the second the
+    model (``tensor``) axis — placed last so per-layer collectives ride the
+    innermost ICI ring — and an optional third factor is the ``pipe``
+    (pipeline-stage) axis: '2x2x2' lays a 3-D (data=2, tensor=2, pipe=2)
+    topology. A single factor ('8') means pure data parallel."""
     parts = [p.strip() for p in text.lower().split("x") if p.strip()]
-    if not parts or len(parts) > 2:
+    if not parts or len(parts) > 3:
         raise ValueError(
-            f"bad mesh shape {text!r}: want 'DATAxMODEL' (e.g. '4x2') or a "
-            "single data-parallel size")
+            f"bad mesh shape {text!r}: want 'DATAxMODEL' (e.g. '4x2'), "
+            "'DATAxMODELxPIPE' (e.g. '2x2x2'), or a single data-parallel "
+            "size")
     sizes = [int(p) for p in parts]
     for n in sizes:
         if n == 0 or n < -1:
@@ -113,10 +116,12 @@ def parse_mesh_shape(text: str) -> MeshSpec:
                 "size or -1 (absorb remaining devices)")
     if len(sizes) == 1:
         return MeshSpec(data=sizes[0])
-    if sizes[1] == -1:
+    if any(n == -1 for n in sizes[1:]):
         raise ValueError(
             f"bad mesh shape {text!r}: only the data factor may be -1")
-    return MeshSpec(data=sizes[0], tensor=sizes[1])
+    if len(sizes) == 2:
+        return MeshSpec(data=sizes[0], tensor=sizes[1])
+    return MeshSpec(data=sizes[0], tensor=sizes[1], pipe=sizes[2])
 
 
 def mesh_from_config(devices: Optional[Sequence] = None) -> Mesh:

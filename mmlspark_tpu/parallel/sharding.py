@@ -138,6 +138,27 @@ def param_shardings(params: Any, mesh: Mesh,
     return jax.tree_util.tree_map_with_path(assign, params)
 
 
+def pipeline_stacked_rules(base: Optional[Rules] = None,
+                           prefix: str = "stages") -> List[Tuple[str, P]]:
+    """Rules for a state tree containing a STACKED pipeline-stage subtree
+    (leaves under ``prefix`` carry a leading stage dim, per
+    ``pipeline_parallel.stack_stage_params``): every base rule is
+    mirrored with ``prefix`` required in the path and ``pipe`` prepended
+    to its spec — stage dim over the ``pipe`` axis, the remaining dims
+    placed exactly as their non-pipelined counterparts — ahead of the
+    unmodified base rules for the leaves outside the pipelined region
+    (embed/head stay un-stacked). THE one home for the 3-D
+    ``(data, tensor, pipe)`` placement policy (lint Rule 14): trainers
+    composing ``pipeline_apply`` pass ``rules=pipeline_stacked_rules()``
+    and the whole train state (params + optimizer mirrors) shards in one
+    pass."""
+    base = list(base) if base is not None else list(DEFAULT_RULES)
+    anchor = r"(?=.*" + re.escape(prefix) + r"/)"
+    staged = [(anchor + pat, P(*(("pipe",) + tuple(spec))))
+              for pat, spec in base]
+    return staged + base
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -87,6 +87,13 @@ SCENARIOS: Dict[str, str] = {
                    "survivors absorb the sessions, tokens bit-identical "
                    "to a single server, and the prefix hit rate recovers "
                    "with zero new compiles",
+    "reshard": "SIGKILL a replica MID-RESHARD while the fleet moves to a "
+               "new mesh placement under fire; zero failed requests, "
+               "scores bit-identical to an untouched reference on both "
+               "placements, the survivors finish the reshard, and the "
+               "HBM ledger reconciles to zero on close (no orphan "
+               "params/kv bytes from the dead replica or the old "
+               "placement)",
 }
 
 # the 2-D topology the *_sharded scenarios run on: tensor=2 model axis,
@@ -748,6 +755,263 @@ def run_recommender_scenario(seed: int, outdir: str, replicas: int = 3,
         from mmlspark_tpu.observability import flightrec
         dumped = flightrec.dump(
             reason=f"chaos.recommender.red.seed{seed}",
+            path=os.path.join(outdir, "chaos_flightrec.jsonl"))
+        if dumped:
+            _LOG.error("chaos: flight recorder dumped to %s", dumped)
+    return verdict
+
+
+def run_reshard_scenario(seed: int, outdir: str, replicas: int = 3,
+                         requests: int = 24,
+                         mesh_to: str = "4x2") -> Dict[str, Any]:
+    """SIGKILL a replica MID-RESHARD; the elastic mesh loses nothing.
+
+    The robustness half of ``Fleet.reshard`` (docs/SERVING.md): while the
+    fleet moves every replica from the single-device placement onto
+    ``mesh_to`` under fire, one seeded replica is killed without drain —
+    timed to land INSIDE the reshard, after the first replica starts
+    draining and before the victim's own turn in the swap order.
+
+    1. **reference** — the full request stream scored on an untouched
+       single :class:`~mmlspark_tpu.serve.server.Server`: the numerics
+       ground truth for BOTH placements (the reshard contract is that
+       placement never moves a bit).
+    2. **fleet under fire** — the same stream through a
+       ``replicas``-wide fleet; at a seeded request the reshard starts
+       in a background thread, a watcher kills the victim the instant
+       the first replica's router weight drops to zero (the reshard's
+       first observable action), and the client keeps submitting through
+       the whole reshard window behind a :class:`RetryPolicy`.
+    3. **post-reshard** — the stream once more, wholly on the new
+       placement.
+
+    Invariants (verdict JSON, ``outdir/chaos_verdict.json``):
+
+    - ``zero_failed_requests``   — no request failed in any phase: not
+      during the swaps, not from the kill, not on the new placement;
+    - ``scores_bit_identical``   — under-fire results == reference, row
+      for row, through drain/swap/kill/failover;
+    - ``scores_bit_identical_post_reshard`` — the resharded fleet still
+      matches the reference bit-for-bit;
+    - ``reshard_survived_kill``  — the survivors all finished
+      (``status="resharded"``), the victim was recorded dead (``died`` /
+      ``skipped_dead``), and the fleet landed on ``mesh_to``;
+    - ``kill_landed_mid_reshard`` — the watcher really fired inside the
+      reshard window;
+    - ``fired_through_reshard``  — requests were served WHILE the
+      reshard was in flight (zero-downtime is a claim about the whole
+      window, not its endpoints);
+    - ``params_charged_while_serving`` / ``ledger_reconciles_on_close``
+      — the HBM ledger carried ``kind="params"`` bytes while serving
+      and holds ZERO bytes of any kind after close: neither the dead
+      replica nor the replaced old-placement entries leak;
+    - ``victim_probed_dead``     — the router's probe answers ``dead``
+      for the victim (dead replicas answer, never wedge);
+    - ``no_unhandled_exceptions``.
+
+    The schedule (reshard point, victim, per-replica statuses) is a pure
+    function of ``seed`` — the tier-1 smoke test asserts byte-identical
+    replay. The kill triggers off the FIRST replica's drain and the
+    victim is never that replica, so the victim is already dead when the
+    swap order reaches it: ``skipped_dead``, deterministically.
+    """
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.observability import memory as devmem
+    from mmlspark_tpu.reliability.retry import RetryPolicy
+    from mmlspark_tpu.serve.fleet import Fleet
+    from mmlspark_tpu.serve.server import Server
+
+    os.makedirs(outdir, exist_ok=True)
+    errors: List[str] = []
+    verdict: Dict[str, Any] = {
+        "seed": seed, "scenario": "reshard", "replicas": replicas,
+        "requests": requests, "mesh_to": mesh_to}
+
+    rng = random.Random(seed ^ 0x4E5A4D)
+    probe_every = max(4, replicas + 1)
+    reshard_at = rng.randint(requests // 3, (2 * requests) // 3)
+    victim = rng.randrange(1, replicas)
+
+    model = JaxModel(inputCol="x", outputCol="y", miniBatchSize=8)
+    model.set_model("mlp_tabular", input_dim=_DIM, hidden=[16],
+                    num_classes=3, seed=seed & 0xFFFF)
+    stream = loadgen.feature_rows(requests, 2, _DIM, seed)
+
+    ledger = devmem.get_ledger()
+    ledger.reset()
+
+    # phase 1: untouched single-server reference
+    ref_server = Server({"chaos": model}, max_batch=4, queue_depth=32)
+    try:
+        reference = [np.asarray(ref_server.submit("chaos", x, timeout=30))
+                     for x in stream]
+    finally:
+        ref_server.close()
+    ledger_after_ref = int(ledger.total())
+
+    # phase 2: fire through the fleet with a background reshard and a
+    # mid-reshard kill; sequential blocking submits keep the request
+    # order (and so the bit-identity comparison) deterministic
+    fleet = Fleet({"chaos": model}, replicas=replicas,
+                  server_kwargs={"max_batch": 4, "queue_depth": 32})
+    client_retry = RetryPolicy(max_attempts=4, base_delay=0.0, jitter=0.0,
+                               name="chaos.reshard.client", seed=seed)
+    results: List[Optional[Any]] = []
+    post: List[Optional[Any]] = []
+    failed = 0
+    probe_rounds: List[Dict[str, str]] = []
+    reshard_box: Dict[str, Any] = {}
+    kill_box: Dict[str, Any] = {}
+    fired_during = 0
+    params_serving = 0
+
+    def _do_reshard() -> None:
+        try:
+            reshard_box["report"] = fleet.reshard(  # lint: allow-actuate
+                mesh_to, warm_x=stream[0])
+        except Exception as e:
+            reshard_box["err"] = e
+
+    def _watch_and_kill() -> None:
+        # the reshard's first observable action is draining replica 0
+        # (router weight -> 0); the kill fires right then, while the
+        # whole swap sequence is still ahead of the victim
+        handle = fleet.router._handles[fleet.replicas[0].name]
+        deadline = _time.monotonic() + 60
+        while _time.monotonic() < deadline:
+            if handle.weight == 0.0:
+                fleet.kill(victim)  # lint: allow-actuate
+                kill_box["killed"] = fleet.replicas[victim].name
+                return
+            _time.sleep(0.0005)
+
+    reshard_t = threading.Thread(
+        target=_do_reshard, daemon=True, name="mmlspark-tpu-chaos-reshard")
+    watcher_t = threading.Thread(
+        target=_watch_and_kill, daemon=True,
+        name="mmlspark-tpu-chaos-reshard-kill")
+    try:
+        for i, x in enumerate(stream):
+            if i % probe_every == 0:
+                probe_rounds.append(fleet.router.probe())
+            if i == reshard_at:
+                watcher_t.start()
+                reshard_t.start()
+            try:
+                results.append(np.asarray(
+                    client_retry.call(fleet.submit, "chaos", x)))
+            except Exception as e:
+                failed += 1
+                results.append(None)
+                errors.append(f"request {i}: {type(e).__name__}: {e}")
+            if reshard_t.is_alive():
+                fired_during += 1
+        # the reshard (fresh-placement compiles per survivor) usually
+        # outlives a short stream: keep healthy traffic flowing until it
+        # lands — zero-downtime is a claim about the WHOLE window
+        spin = itertools.cycle(stream)
+        spin_deadline = _time.monotonic() + 120
+        while reshard_t.is_alive() and _time.monotonic() < spin_deadline:
+            try:
+                client_retry.call(fleet.submit, "chaos", next(spin))
+                fired_during += 1
+            except Exception as e:
+                failed += 1
+                errors.append(f"recovery: {type(e).__name__}: {e}")
+        reshard_t.join(10)
+        watcher_t.join(10)
+        if reshard_t.is_alive():
+            errors.append("reshard wedged: thread still alive")
+        if "err" in reshard_box:
+            e = reshard_box["err"]
+            errors.append(f"reshard raised: {type(e).__name__}: {e}")
+        probe_rounds.append(fleet.router.probe())
+        params_serving = int(ledger.total(kind="params"))
+        # phase 3: the stream once more, wholly on the new placement
+        for i, x in enumerate(stream):
+            try:
+                post.append(np.asarray(
+                    client_retry.call(fleet.submit, "chaos", x)))
+            except Exception as e:
+                failed += 1
+                post.append(None)
+                errors.append(f"post {i}: {type(e).__name__}: {e}")
+    finally:
+        fleet.close()
+    ledger_after_close = int(ledger.total())
+    params_after = int(ledger.total(kind="params"))
+    kv_after = int(ledger.total(kind="kv"))
+
+    identical = all(r is not None and np.array_equal(r, ref)
+                    for r, ref in zip(results, reference))
+    identical_post = all(r is not None and np.array_equal(r, ref)
+                         for r, ref in zip(post, reference))
+    report = reshard_box.get("report", {})
+    statuses = [{"replica": r.get("replica"), "status": r.get("status")}
+                for r in report.get("replicas", [])]
+    victim_name = f"r{victim}"
+    survivors_ok = (
+        bool(statuses)
+        and all(s["status"] == "resharded" for s in statuses
+                if s["replica"] != victim_name)
+        and all(s["status"] in ("died", "skipped_dead") for s in statuses
+                if s["replica"] == victim_name)
+        and report.get("mesh_shape") == mesh_to
+        and getattr(fleet, "mesh_shape", "") == mesh_to)
+    victim_dead = (probe_rounds
+                   and probe_rounds[-1].get(victim_name) == "dead")
+
+    verdict["schedule"] = {
+        "reshard_at": reshard_at, "victim": victim_name,
+        "statuses": statuses, "mesh_to": mesh_to,
+        "resharded": report.get("resharded"),
+    }
+    verdict["fleet"] = {
+        "served": sum(1 for r in results if r is not None),
+        "failed": failed, "probe_rounds": len(probe_rounds),
+    }
+    verdict["ledger"] = {
+        "after_reference_close": ledger_after_ref,
+        "params_bytes_serving": params_serving,
+        "params_bytes_after_close": params_after,
+        "kv_bytes_after_close": kv_after,
+        "total_bytes_after_close": ledger_after_close,
+    }
+    invariants = {
+        "zero_failed_requests": failed == 0,
+        "scores_bit_identical": identical,
+        "scores_bit_identical_post_reshard": identical_post,
+        "reshard_survived_kill": survivors_ok,
+        "kill_landed_mid_reshard": "killed" in kill_box,
+        "fired_through_reshard": fired_during > 0,
+        "params_charged_while_serving": params_serving > 0,
+        "ledger_reconciles_on_close": (ledger_after_ref == 0
+                                       and ledger_after_close == 0
+                                       and params_after == 0
+                                       and kv_after == 0),
+        "victim_probed_dead": bool(victim_dead),
+        "no_unhandled_exceptions": not errors,
+    }
+    verdict["invariants"] = invariants
+    verdict["errors"] = errors
+    verdict["passed"] = all(invariants.values())
+
+    path = os.path.join(outdir, VERDICT_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(verdict, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    _LOG.info("chaos reshard verdict (%s): %s", path,
+              "PASS" if verdict["passed"] else "FAIL")
+    if not verdict["passed"]:
+        from mmlspark_tpu.observability import flightrec
+        dumped = flightrec.dump(
+            reason=f"chaos.reshard.red.seed{seed}",
             path=os.path.join(outdir, "chaos_flightrec.jsonl"))
         if dumped:
             _LOG.error("chaos: flight recorder dumped to %s", dumped)

@@ -117,8 +117,9 @@ was supposed to split. Route through the sharding helpers
 Rule 15 — fleet actuator calls (``set_weight`` / ``kill_replica`` /
 ``scale_up`` / ``scale_down`` / ``add_replica`` / ``remove_replica`` /
 ``set_capacity`` / ``reset_breaker`` / ``add_slot`` / ``retire_slot`` /
-``launch_host`` / ``stop_host``, plus ``.kill()`` on a
-replica/fleet receiver) outside ``control/`` and the existing
+``launch_host`` / ``stop_host`` / ``reshard`` / ``reshard_to``, plus
+``.kill()`` on a replica/fleet receiver) outside ``control/`` and the
+existing
 rollout/supervisor/launcher homes: every control action must stay
 attributable —
 an actuation from a random module is invisible to the autopilot's
@@ -257,7 +258,7 @@ _ACTUATE_HOMES = ("control/autopilot.py", "serve/router.py",
 _ACTUATE_CALLS = ("set_weight", "kill_replica", "scale_up", "scale_down",
                   "add_replica", "remove_replica", "set_capacity",
                   "reset_breaker", "add_slot", "retire_slot",
-                  "launch_host", "stop_host")
+                  "launch_host", "stop_host", "reshard", "reshard_to")
 _ALLOW_HANDLOAD = "# lint: allow-handload"
 # the ONE module chaos scenarios may construct load through (schedules,
 # feature streams, token prompts, prefix populations — all seeded,

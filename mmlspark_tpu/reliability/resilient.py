@@ -25,6 +25,7 @@ PRs (elastic pods, serving warm-restarts) build on.
 """
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from mmlspark_tpu.reliability import preemption
@@ -39,14 +40,88 @@ class ResilientTrainLoop:
     ``save_every`` is the checkpoint cadence in steps (the crash-loss
     window); the final step always commits with ``wait=True`` so a clean
     exit never loses the tail.
+
+    ``trainer_factory(mesh) -> trainer`` enables the ELASTIC MESH lever:
+    :meth:`reshard_to` requests a new ``(data, tensor[, pipe])`` shape
+    and the loop honors it at the next step boundary — drain to a
+    consistent checkpoint (+ input-pipeline sidecar when streaming),
+    rebuild the trainer on the new mesh, restore the SAME state across
+    mesh shapes, and continue with the SAME live iterator, so the batch
+    stream is bit-identical to an un-resharded run. A factory (not a
+    mutated trainer) because ``DistributedTrainer`` fixes its mesh and
+    compiled steps at construction.
     """
 
     def __init__(self, trainer, checkpointer,
-                 init_params_fn: Callable[[], Any], save_every: int = 1):
+                 init_params_fn: Callable[[], Any], save_every: int = 1,
+                 trainer_factory: Optional[Callable[[Any], Any]] = None):
         self.trainer = trainer
         self.ckpt = checkpointer
         self.init_params_fn = init_params_fn
         self.save_every = save_every
+        self.trainer_factory = trainer_factory
+        self._reshard_lock = threading.Lock()
+        self._pending_reshard: Optional[str] = None
+
+    # -- elastic mesh (lint Rule 15: a fenced actuator) ---------------------
+    def reshard_to(self, mesh_shape: str) -> None:
+        """Request a mid-run mesh change (``'4x2'``, ``'2x2x2'``, ...).
+
+        Thread-safe and asynchronous: the request is honored at the next
+        STEP BOUNDARY (a rendezvous — never mid-step), where the loop
+        drains to a consistent checkpoint + data-state sidecar, rebuilds
+        the trainer via ``trainer_factory`` on the new mesh, restores the
+        state across mesh shapes (the PR 13 checkpoint contract), and
+        resumes the SAME batch stream. Killed mid-reshard, the next run
+        restores the drained checkpoint on whatever mesh ITS trainer was
+        built with — position is never lost. Requires ``trainer_factory``
+        (raises immediately otherwise: a request that could never be
+        honored must not be accepted silently)."""
+        if self.trainer_factory is None:
+            raise RuntimeError(
+                "reshard_to needs a trainer_factory(mesh) -> trainer; "
+                "construct ResilientTrainLoop with one")
+        # parse eagerly so a bad shape surfaces at the call site, not
+        # inside the training loop
+        from mmlspark_tpu.parallel.mesh import parse_mesh_shape
+        parse_mesh_shape(mesh_shape)
+        with self._reshard_lock:
+            self._pending_reshard = mesh_shape
+
+    def _take_pending_reshard(self) -> Optional[str]:
+        with self._reshard_lock:
+            shape, self._pending_reshard = self._pending_reshard, None
+            return shape
+
+    def _maybe_reshard(self, state: Any, step: int,
+                       it: Any = None) -> Any:
+        """The step-boundary rendezvous: when a reshard is pending, drain
+        to a consistent checkpoint (sidecar first — an orphan snapshot is
+        harmless, a committed step without one would restart the stream),
+        swap the trainer onto the new mesh, and restore the state into
+        its placement. Returns the (possibly resharded) state."""
+        if step <= 0:
+            return state   # nothing checkpointable yet; stays pending
+        shape = self._take_pending_reshard()
+        if shape is None:
+            return state
+        from mmlspark_tpu.parallel.mesh import make_mesh, parse_mesh_shape
+        _LOG.warning("resharding at step %d to mesh %s", step, shape)
+        self.ckpt.wait()
+        if self.ckpt.latest_step() != step:
+            if it is not None:
+                self.ckpt.put_data_state(step, it.state_dict())
+            self.ckpt.save(state, step=step, wait=True)
+        mesh = make_mesh(parse_mesh_shape(shape))
+        self.trainer = self.trainer_factory(mesh)
+        state = self.ckpt.restore(self.trainer, self.init_params_fn,
+                                  step=step)
+        from mmlspark_tpu.observability import events, metrics
+        metrics.counter("reliability.reshards").inc()
+        if events.events_enabled():
+            events.emit("event", "train.reshard", step=step,
+                        mesh_shape=shape)
+        return state
 
     def restore_or_init(self) -> Tuple[Any, int]:
         """(state, start_step): newest VALID checkpoint, else fresh init.
@@ -100,6 +175,7 @@ class ResilientTrainLoop:
         for step in range(start + 1, total_steps + 1):
             if preemption.preempted():
                 return self._drain(state, step - 1)
+            state = self._maybe_reshard(state, step - 1)
             batch = self.trainer.put_batch(batch_fn(step))
             state, _metrics = self.trainer.train_step(state, batch, rng)
             self.ckpt.maybe_save(state, every=self.save_every, step=step)
@@ -166,6 +242,7 @@ class ResilientTrainLoop:
                 if preemption.preempted():
                     return self._drain(state, step - 1,
                                        data_state=it.state_dict())
+                state = self._maybe_reshard(state, step - 1, it=it)
                 try:
                     host = next(it)
                 except StopIteration:

@@ -171,6 +171,10 @@ class Fleet:
         self._server_kwargs = skw
         self._start = start
         self._next_idx = n
+        # current mesh placement, canonical 'DxT[xP]' text ('' = the
+        # models' own meshSpec, untouched). reshard() maintains it; the
+        # autopilot's reshard lever reads it to veto "already there".
+        self.mesh_shape: str = ""
         self.servers = [Server(models, start=start, **skw)
                         for _ in range(n)]
         self.replicas = [InProcessReplica(f"r{i}", srv)
@@ -336,6 +340,176 @@ class Fleet:
                               for r in self.replicas if not r._dead}
         return report
 
+    # -- elastic mesh (lint Rule 15; the autopilot's fifth lever) -----------
+    def reshard(self, mesh_shape, *, models: Optional[Sequence[str]] = None,
+                warm_x=None,
+                drain_timeout_s: Optional[float] = None) -> Dict:
+        """Change the mesh placement of the SERVING fleet with zero
+        downtime: every served model's SAME checkpoint is loaded into a
+        NEW mesh placement, one replica at a time, through the exact
+        drain -> swap -> warm -> shift sequence :meth:`rollout` uses.
+
+        ``mesh_shape`` is the ``parallel.mesh_shape`` shorthand
+        (``'4x2'``, ``'2x2x2'`` for a 3-D ``(data, tensor, pipe)``
+        topology), a :class:`~mmlspark_tpu.parallel.mesh.MeshSpec`, or
+        ``None`` to return to the single-device fast path. One resharded
+        copy per model is shared by EVERY replica — the fleet pays one
+        compile per program, and with ``runtime.compile_cache_dir`` set a
+        pre-warmed target placement loads serialized executables instead
+        (``steady_compiles == 0`` through the whole reshard). Scores are
+        bit-identical throughout: same checkpoint, same numerics path,
+        only the placement moves.
+
+        Generate lanes re-shard with their model: the old lane drains
+        (in-flight sequences complete on the OLD placement) then closes —
+        anything still unfinished fails retryably and the router
+        failover-restarts it token-identically — and a fresh lane with a
+        KV arena on the NEW placement is built before the replica takes
+        traffic again.
+
+        A placement that cannot fit the registry budget raises
+        :class:`~mmlspark_tpu.serve.registry.PlacementOverBudget` from
+        the FIRST replica's swap, before any entry is dropped — the
+        whole reshard degrades to a no-op with every replica still
+        serving. A replica killed mid-reshard is recorded
+        (``status="died"``) and skipped; the survivors complete.
+
+        ``warm_x`` is a sample row/batch (single served model) or a
+        ``{name: sample}`` dict; as in :meth:`rollout` it AOT-compiles
+        each bucket before the replica re-enters rotation."""
+        from mmlspark_tpu.parallel.mesh import MeshSpec, parse_mesh_shape
+        if isinstance(mesh_shape, str) and mesh_shape:
+            spec = parse_mesh_shape(mesh_shape)
+        elif isinstance(mesh_shape, MeshSpec) or mesh_shape is None:
+            spec = mesh_shape
+        else:
+            raise TypeError(
+                f"mesh_shape must be a 'DxT[xP]' string, MeshSpec, or "
+                f"None; got {type(mesh_shape).__name__}")
+        shape_text = self._shape_text(spec)
+        timeout = float(drain_timeout_s if drain_timeout_s is not None
+                        else mmlconfig.get("serving.drain_timeout_s"))
+        names = list(models) if models is not None else \
+            sorted(self._models)
+        for n in names:
+            if n not in self._models:
+                raise KeyError(f"unknown model {n!r}; fleet serves "
+                               f"{sorted(self._models)}")
+        # one resharded copy per model, shared fleet-wide: same
+        # checkpoint (deep-copied state), new placement via meshSpec —
+        # the _cached_jit key includes repr(meshSpec), so old and new
+        # placements never collide in the program caches
+        copies = {}
+        for n in names:
+            m = self._models[n].copy()
+            setter = getattr(m, "set_params", None)
+            if setter is None:
+                raise TypeError(
+                    f"model {n!r} ({type(m).__name__}) does not carry a "
+                    "meshSpec param; reshard needs JaxModel-style models")
+            setter(meshSpec=spec)
+            copies[n] = m
+        warm = dict(warm_x) if isinstance(warm_x, dict) else \
+            {n: warm_x for n in names}
+        report: Dict = {"mesh_shape": shape_text, "models": names,
+                        "replicas": []}
+        if events.recording_enabled():
+            events.emit("reshard", "start", mesh_shape=shape_text,
+                        models=names, replicas=len(self.replicas))
+        for rep in list(self.replicas):  # scale events must not shift it
+            if rep._dead:
+                report["replicas"].append(
+                    {"replica": rep.name, "status": "skipped_dead"})
+                continue
+            step = {"replica": rep.name, "status": "resharded"}
+            weight = self.router._handles[rep.name].weight
+            self.router.set_weight(rep.name, 0.0)
+            try:
+                self._wait_idle(rep.server, timeout)
+                for n in names:
+                    # drain + retire the OLD placement's lane first: its
+                    # arena and programs are bound to the entry we are
+                    # about to replace
+                    had_lane = n in rep.server._lanes
+                    if had_lane:
+                        self._wait_lane_idle(rep.server._lanes[n],
+                                             timeout)
+                        rep.server.reset_lane(n, timeout_s=timeout)
+                    version = rep.server.registry.versions().get(n, "v1")
+                    entry = rep.server.registry.replace(
+                        n, copies[n], version)
+                    self._warm(rep, entry, n, warm.get(n))
+                    if had_lane:
+                        # fresh lane against the NEW entry: KV arena
+                        # re-sharded onto the target placement before
+                        # the replica takes traffic
+                        rep.server.enable_generate(n)
+                    step[f"compiles:{n}"] = entry.compile_count
+                    step[f"cache_hits:{n}"] = entry.cache_hits
+            except Exception as e:
+                if rep._dead or not rep.health()["live"]:
+                    # a kill landed mid-reshard: record and move on —
+                    # the dead replica is the router's problem
+                    # (failover), not the reshard's
+                    step["status"] = "died"
+                    step["error"] = f"{type(e).__name__}: {e}"
+                    report["replicas"].append(step)
+                    if events.recording_enabled():
+                        events.emit("reshard", "replica_died",
+                                    replica=rep.name,
+                                    mesh_shape=shape_text)
+                    continue
+                # no-op semantics: this replica back in rotation on its
+                # CURRENT placement, then surface the failure
+                self.router.set_weight(rep.name, weight)
+                if events.recording_enabled():
+                    events.emit("reshard", "abort", replica=rep.name,
+                                mesh_shape=shape_text,
+                                reason=f"{type(e).__name__}: {e}")
+                raise
+            self.router.set_weight(rep.name, weight)
+            report["replicas"].append(step)
+            if events.recording_enabled():
+                events.emit("reshard", "shift", replica=rep.name,
+                            mesh_shape=shape_text, weight=weight)
+        # scale_up() must build replicas on the NEW placement, and a
+        # repeat reshard must copy from the resharded models
+        self._models = dict(self._models)
+        self._models.update(copies)
+        self.mesh_shape = shape_text
+        report["resharded"] = sum(1 for r in report["replicas"]
+                                  if r["status"] == "resharded")
+        if events.recording_enabled():
+            events.emit("reshard", "done", mesh_shape=shape_text,
+                        resharded=report["resharded"],
+                        replicas=len(self.replicas))
+        return report
+
+    @staticmethod
+    def _shape_text(spec) -> str:
+        """Canonical 'DxT[xP]' text for a MeshSpec ('' for None) — the
+        comparison key the autopilot's reshard lever uses."""
+        if spec is None:
+            return ""
+        parts = [spec.data, spec.tensor]
+        if spec.pipe != 1:
+            parts.append(spec.pipe)
+        return "x".join(str(p) for p in parts)
+
+    def _wait_lane_idle(self, lane, timeout_s: float) -> None:
+        """Bounded wait for a generate lane's in-flight sequences to
+        finish on the OLD placement. Best-effort: on timeout the lane's
+        close fails the stragglers retryably and the router restarts
+        them token-identically elsewhere — either way no tokens are
+        lost."""
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        while time.monotonic() < deadline:
+            s = lane.stats()
+            if s.get("waiting", 0) + s.get("active", 0) \
+                    + s.get("prefilling", 0) <= 0:
+                return
+            self._sleep(0.005)
+
     def _wait_idle(self, server: Server, timeout_s: float) -> None:
         """Drain: wait for the replica's in-flight count to hit zero
         (admission continues — only the ROUTER stopped sending; a direct
@@ -484,6 +658,18 @@ class ProcessFleet:
         drain, SIGKILL stragglers). Idempotent on unknown names, like
         :meth:`Fleet.scale_down`."""
         self.supervisor.retire_slot(name, drain_timeout_s=drain_timeout_s)
+
+    def reshard(self, mesh_shape, **kw):
+        """Not yet supported for process-backed fleets: each worker
+        process owns its model placement, so an elastic reshard means a
+        rolling worker restart under a new ``parallel.mesh_shape`` —
+        future work. Raising (instead of silently no-oping) keeps the
+        autopilot honest: its actuation is recorded as failed and the
+        lever cools down."""
+        raise NotImplementedError(
+            "ProcessFleet.reshard: restart workers with a new "
+            "parallel.mesh_shape instead (rolling, via scale_up/"
+            "scale_down); in-process Fleet supports live reshard")
 
     # -- lifecycle ----------------------------------------------------------
     def close(self) -> None:

@@ -40,6 +40,13 @@ from mmlspark_tpu.utils import config as mmlconfig
 _param_bytes = devmem.param_shard_bytes
 
 
+class PlacementOverBudget(ValueError):
+    """A ``replace`` target placement's per-shard bytes exceed the
+    registry budget. Raised BEFORE the old entry is dropped, so the
+    caller's running version keeps serving — a bad reshard target
+    degrades to a no-op, not an eviction storm."""
+
+
 class ModelEntry:
     """One served model: coercion spec, bound apply, per-bucket programs."""
 
@@ -112,12 +119,18 @@ class ModelEntry:
         params = apply._params
         mesh = getattr(apply, "_mesh", None)
         if mesh is not None:
+            # placement identity in the cache key: an elastic reshard
+            # serves the same name+version under different placements
+            # and their partitioned executables must not collide
+            mesh_key = ",".join(f"{a}={int(s)}"
+                                for a, s in mesh.shape.items()
+                                if int(s) > 1)
             # trace-time sharding constraints inside apply may name mesh
             # axes bare — keep the mesh current while lowering
             with mesh:
                 result = compile_cache.load_or_compile(
                     self.name, self.version, bucket, tuple(row_shape),
-                    dtype, jitted, params)
+                    dtype, jitted, params, mesh_key=mesh_key)
         else:
             result = compile_cache.load_or_compile(
                 self.name, self.version, bucket, tuple(row_shape), dtype,
@@ -215,13 +228,28 @@ class ModelRegistry:
             return entry
 
     def replace(self, name: str, model, version: str) -> ModelEntry:
-        """Atomically swap the entry behind ``name`` (the rollout
-        cutover): lookups from the swap onward get the new version; a
-        batch already holding the OLD entry finishes on it (that request
-        was admitted pre-cutover). The old entry is evicted so its
-        compiled programs and params become collectable — "retire old"
-        in the rollout sequence. Unknown names register fresh (a rollout
-        may introduce a model)."""
+        """Atomically swap the entry behind ``name`` (the rollout /
+        reshard cutover): lookups from the swap onward get the new
+        version; a batch already holding the OLD entry finishes on it
+        (that request was admitted pre-cutover). The old entry is evicted
+        so its compiled programs and params become collectable — "retire
+        old" in the rollout sequence. Unknown names register fresh (a
+        rollout may introduce a model).
+
+        The swap is guarded by a projected-bytes pre-check: a new
+        placement whose PER-SHARD bytes cannot fit the budget raises
+        :class:`PlacementOverBudget` BEFORE the old entry is touched —
+        the running version keeps serving, instead of the old behaviour
+        where the doomed replacement evicted every other warm model and
+        then failed anyway."""
+        projected = self.projected_bytes(model)
+        budget = self.budget_bytes()
+        if projected > budget:
+            raise PlacementOverBudget(
+                f"model {name!r} replacement rejected: projected per-shard "
+                f"bytes {int(projected)} exceed the registry budget "
+                f"{int(budget)} (runtime.device_cache_mb); the current "
+                "entry keeps serving")
         with self._lock:
             old = self._entries.pop(name, None)
             entry = ModelEntry(name, model, version=version)
@@ -229,6 +257,19 @@ class ModelRegistry:
         if old is not None and old.warm:
             old.evict()
         return entry
+
+    @staticmethod
+    def projected_bytes(model) -> int:
+        """Per-shard bytes ``model`` would pin once warmed, from host
+        shapes + its ``meshSpec`` placement alone (nothing device-side;
+        ledger arithmetic, lint Rule 11). 0 for models that carry no
+        param state (stub scorers in tests)."""
+        params = (getattr(model, "_state", None) or {}).get("params")
+        if params is None:
+            return 0
+        resolve = getattr(model, "_resolve_score_mesh", None)
+        mesh = resolve() if callable(resolve) else None
+        return devmem.projected_shard_bytes(params, mesh)
 
     def get(self, name: str) -> ModelEntry:
         with self._lock:

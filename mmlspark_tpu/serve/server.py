@@ -406,6 +406,25 @@ class Server:
                 self._lanes[model] = lane
         return lane
 
+    def reset_lane(self, model: str,
+                   timeout_s: Optional[float] = None) -> bool:
+        """Close and forget the generate lane for ``model`` (False when
+        it has none). The reshard seam: a lane's KV arena and bucketed
+        prefill/decode programs are bound to the placement of the entry
+        it was built against, so after a ``registry.replace`` onto a new
+        mesh the old lane must die — the next ``submit_generate`` (or an
+        explicit ``enable_generate``) builds a fresh lane against the
+        CURRENT entry, arena re-sharded onto the new placement. Closing
+        fails unfinished sequences with a retryable error; the fleet
+        router failover-restarts them from their prompts, token-
+        identically under seeded sampling."""
+        with self._admit:
+            lane = self._lanes.pop(model, None)
+        if lane is None:
+            return False
+        lane.close(timeout_s=timeout_s)
+        return True
+
     def submit_generate(self, model: str, prompt,
                         max_new_tokens: Optional[int] = None, *,
                         temperature: float = 0.0, top_k: int = 0,

@@ -253,6 +253,21 @@ _DEFAULTS: Dict[str, Any] = {
     "autopilot.admission_relax_burn": 1.0,  # fast burn at/below which a
                                             # tightened quota relaxes
     "autopilot.admission_cooldown_s": 25.0,
+    "autopilot.reshard_wide": "",      # fifth lever: mesh shape to reshard
+                                       # TO under HBM-ledger pressure
+                                       # (e.g. "2x4" — wider tensor axis,
+                                       # smaller per-chip shard); "" = off
+    "autopilot.reshard_narrow": "",    # mesh shape to reshard TO when
+                                       # queue depth wants replicas past
+                                       # max_replicas (e.g. "4x2"); "" =
+                                       # off. wide != narrow: the gap is
+                                       # the hysteresis band
+    "autopilot.reshard_hbm_frac": 0.85,  # HBM fraction of hbm_limit_bytes
+                                         # at/above which the wide reshard
+                                         # fires
+    "autopilot.reshard_cooldown_s": 60.0,  # shared by BOTH directions (one
+                                           # "reshard" cooldown key), so
+                                           # placements cannot oscillate
     "autopilot.window_s": 120.0,       # rolling actuation-budget window
     "autopilot.max_actions_per_window": 8,  # hard budget: decisions past
                                             # it are suppressed ("window")

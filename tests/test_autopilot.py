@@ -585,3 +585,135 @@ def test_chaos_autopilot_scenario_is_deterministic(tmp_path):
     on_disk = json.loads(
         (tmp_path / "a" / chaos.VERDICT_FILE).read_text())
     assert on_disk["passed"] is True
+
+
+# -- the fifth lever: elastic mesh reshard ------------------------------------
+
+def _mesh_sig(shape="", **kw):
+    s = sig(**kw)
+    s["mesh"] = {"shape": shape}
+    return s
+
+
+RESHARD_POLICY = AutopilotPolicy(
+    max_replicas=2, hbm_limit_bytes=1000,
+    reshard_wide="2x4", reshard_narrow="4x2",
+    reshard_hbm_frac=0.85, reshard_cooldown_s=120.0)
+
+
+def test_reshard_policy_validation():
+    with pytest.raises(ValueError):
+        AutopilotPolicy(reshard_hbm_frac=0.0)
+    with pytest.raises(ValueError):
+        AutopilotPolicy(reshard_wide="4x2", reshard_narrow="4x2")
+    # both directions off by default — the lever is opt-in
+    assert AutopilotPolicy().reshard_wide == ""
+    p = AutopilotPolicy.from_config()
+    assert p.reshard_hbm_frac == float(
+        config.get("autopilot.reshard_hbm_frac"))
+
+
+def test_hbm_pressure_reshards_wide():
+    st = AutopilotState()
+    s = _mesh_sig("4x2", replicas={"r0": rep()}, hbm=900.0)
+    ds = decide(s, RESHARD_POLICY, st)
+    resh = [d for d in ds if d["lever"] == "reshard"]
+    assert [d["action"] for d in acted(resh)] == ["reshard_wide"]
+    d = acted(resh)[0]
+    assert d["target"] == "2x4" and d["mesh_shape"] == "4x2"
+    assert d["hbm_bytes"] == 900 and "hbm" in d["reason"]
+
+
+def test_reshard_wide_at_target_is_a_visible_veto():
+    st = AutopilotState()
+    s = _mesh_sig("2x4", replicas={"r0": rep()}, hbm=900.0)
+    resh = [d for d in decide(s, RESHARD_POLICY, st)
+            if d["lever"] == "reshard"]
+    (d,) = resh
+    assert d["suppressed"] and d["reason"].startswith("bounds:at_target")
+
+
+def test_queue_pressure_past_max_replicas_reshards_narrow():
+    st = AutopilotState()
+    # queue wants replicas, the scale lever is at max -> narrow reshard
+    s = _mesh_sig("2x4", replicas={"r0": rep(q=9.0), "r1": rep(q=9.0)})
+    ds = decide(s, RESHARD_POLICY, st)
+    assert any(d["suppressed"] and d["reason"].startswith(
+        "bounds:max_replicas") for d in ds if d["lever"] == "scale")
+    resh = [d for d in ds if d["lever"] == "reshard"]
+    assert [d["action"] for d in acted(resh)] == ["reshard_narrow"]
+    assert acted(resh)[0]["target"] == "4x2"
+
+
+def test_reshard_cooldown_is_shared_across_directions():
+    """Both directions share ONE 'reshard' cooldown key — the structural
+    guarantee placements cannot oscillate inside a cooldown."""
+    assert cooldown_key("reshard", "2x4") == "reshard" \
+        == cooldown_key("reshard", "4x2")
+    st = AutopilotState()
+    s = _mesh_sig("4x2", now=1000.0, replicas={"r0": rep()}, hbm=900.0)
+    advance_state(st, decide(s, RESHARD_POLICY, st), s,
+                  window_s=RESHARD_POLICY.window_s)
+    # seconds later the OPPOSITE direction wants to fire: held
+    s2 = _mesh_sig("2x4", now=1030.0,
+                   replicas={"r0": rep(q=9.0), "r1": rep(q=9.0)})
+    resh = [d for d in decide(s2, RESHARD_POLICY, st)
+            if d["lever"] == "reshard"]
+    (d,) = resh
+    assert d["suppressed"] and d["reason"].startswith("cooldown:reshard")
+    assert "wanted:" in d["reason"]
+    # past the cooldown the narrow direction acts
+    s3 = _mesh_sig("2x4", now=1130.0,
+                   replicas={"r0": rep(q=9.0), "r1": rep(q=9.0)})
+    resh3 = [d for d in decide(s3, RESHARD_POLICY, st)
+             if d["lever"] == "reshard"]
+    assert [d["action"] for d in acted(resh3)] == ["reshard_narrow"]
+
+
+def test_reshard_disabled_policy_never_fires():
+    st = AutopilotState()
+    s = _mesh_sig("4x2", replicas={"r0": rep(q=9.0)}, hbm=99999.0)
+    assert not [d for d in decide(s, POLICY, st)
+                if d["lever"] == "reshard"]
+
+
+def test_fleet_signals_carries_mesh_shape():
+    snap = {"replicas": {"r0": {"ready": True, "live": True,
+                                "stats": {"queue_depth": 1.0}}},
+            "memory": {"total_bytes": 10.0}}
+    s = fleet_signals(snap, [], {"replicas": {}}, 123.0,
+                      mesh_shape="2x2x2")
+    assert s["mesh"] == {"shape": "2x2x2"}
+    # absent mesh_shape -> no mesh key (decide treats it as "")
+    s2 = fleet_signals(snap, [], {"replicas": {}}, 123.0)
+    assert "mesh" not in s2
+
+
+def test_autopilot_actuates_reshard_on_live_fleet(tmp_path):
+    """Closed loop: HBM pressure + a reshard_wide policy actuate
+    ``Fleet.reshard`` through ``_actuate``; the fleet's mesh_shape
+    feeds back so the next tick vetoes at-target."""
+    x = np.zeros((1, _DIM), np.float32)
+    clock = lambda: 1000.0  # noqa: E731
+    with Fleet({"mlp": _model()}, replicas=1,
+               server_kwargs={"max_batch": 4}) as fleet:
+        fleet.submit("mlp", x)
+        policy = AutopilotPolicy(
+            min_replicas=1, max_replicas=1, hbm_limit_bytes=1,
+            reshard_wide="4x2", reshard_hbm_frac=0.5,
+            reshard_cooldown_s=0.0, scale_down_queue=-1.0)
+        ap = Autopilot(fleet, policy=policy, clock=clock)
+        ds = ap.tick()
+        resh = [d for d in ds if d["lever"] == "reshard"]
+        assert [d["action"] for d in acted(resh)] == ["reshard_wide"]
+        assert "error" not in acted(resh)[0]
+        assert acted(resh)[0]["report"]["resharded"] == 1
+        assert fleet.mesh_shape == "4x2"
+        spec = fleet.servers[0].registry.get("mlp").model.get("meshSpec")
+        assert (spec.data, spec.tensor) == (4, 2)
+        # feedback: the fleet now reports the target shape -> veto
+        ds2 = ap.tick()
+        resh2 = [d for d in ds2 if d["lever"] == "reshard"]
+        assert resh2 and all(d["suppressed"] for d in resh2)
+        assert resh2[0]["reason"].startswith("bounds:at_target")
+        fleet.submit("mlp", x)

@@ -157,3 +157,40 @@ def test_set_state_drops_out_spec_memo():
     m._out_spec_cache = (("k",), object())
     m._set_state({"params": {}})
     assert m._out_spec_cache is None
+
+
+def test_fleet_reshard_lane_is_registered():
+    """The elastic-mesh lane must stay wired: registered under CONFIGS
+    (so ``--configs fleet_reshard`` resolves), carrying the open-loop
+    delivery-ratio unit the gate's goodput checks key on, and listed in
+    XL_CONFIGS so the emulated 8-device mesh is forced BEFORE the first
+    jax import — without it the 4x2 serve placement and the 2x2x2 train
+    placement both fail mesh construction on a 1-device host."""
+    assert "fleet_reshard" in bench.CONFIGS
+    assert bench.CONFIG_UNITS["fleet_reshard"] == "delivery ratio"
+    assert "fleet_reshard" in bench.XL_CONFIGS
+
+
+def test_benchgate_accepts_fleet_reshard_baseline(tmp_path):
+    """BENCH_r12.json's wrapper shape must round-trip through the gate:
+    load_baseline unwraps ``parsed`` and gate() goes green when fresh
+    equals baseline, red when goodput drops through a live reshard."""
+    from mmlspark_tpu.observability import benchgate
+    lane = {"value": 1.0, "unit": "delivery ratio", "vs_baseline": 1.0,
+            "goodput": 1.0, "arrival_p99_ms": 140.0, "deadline_ms": 5000.0,
+            "steady_compiles": 0, "train_loss_delta": 0.0}
+    line = {"metric": "bench_fleet_reshard", "value": 1.0,
+            "unit": "delivery ratio", "vs_baseline": 1.0,
+            "configs": {"fleet_reshard": dict(lane)}}
+    p = tmp_path / "BENCH_r12.json"
+    p.write_text(json.dumps({"cmd": "python bench.py --configs "
+                             "fleet_reshard", "n": 10, "parsed": line,
+                             "rc": 0, "tail": ""}))
+    assert benchgate.load_baseline(str(p))["configs"]["fleet_reshard"][
+        "goodput"] == 1.0
+    assert benchgate.gate(dict(line), str(p))["green"] is True
+    degraded = json.loads(json.dumps(line))
+    degraded["configs"]["fleet_reshard"]["goodput"] = 0.5
+    degraded["configs"]["fleet_reshard"]["value"] = 0.5
+    degraded["value"] = 0.5
+    assert benchgate.gate(degraded, str(p))["green"] is False

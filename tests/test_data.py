@@ -22,7 +22,7 @@ from mmlspark_tpu.io.readers import read_images
 from mmlspark_tpu.observability import events as obsevents
 from mmlspark_tpu.observability import metrics as obsmetrics
 from mmlspark_tpu.parallel.checkpoint import TrainCheckpointer
-from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh, parse_mesh_shape
 from mmlspark_tpu.parallel.trainer import DistributedTrainer
 from mmlspark_tpu.reliability.faults import FaultPlan, FaultSpec, InjectedFault
 from mmlspark_tpu.reliability.resilient import ResilientTrainLoop
@@ -584,3 +584,153 @@ def test_multi_hot_snapshot_resume_bit_identical():
 def test_multi_hot_validates_slots():
     with pytest.raises(ValueError, match="slots"):
         Batcher(_Ragged(4), 2, multi_hot={"item_ids": 0})
+
+
+# -- (c) elastic mesh: reshard_to mid-epoch ----------------------------------
+
+def _trainer_factory(mesh):
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return ((pred - batch["y"]) ** 2).mean()
+
+    return DistributedTrainer(loss_fn, optax.adam(1e-2), mesh=mesh)
+
+
+def _write_vec_shards(root: Path):
+    root.mkdir()
+    for i in range(32):
+        rng = np.random.default_rng(i)
+        (root / f"r_{i:03d}.bin").write_bytes(
+            rng.normal(0, 1, (DIM,)).astype(np.float32).tobytes())
+
+
+def _reshard_pipeline(root: Path, tap: list, hook: list, trigger_at=40):
+    """The float-vec pipeline plus a tap recording every consumed batch and
+    a record-count trigger that requests ``reshard_to("2x4")`` mid-run. The
+    trigger is a pure function of the pull sequence, so every run reshards
+    at the same step boundary — the determinism the bit-identity
+    assertions below lean on."""
+    seen = [0]
+
+    def parse(rec):
+        seen[0] += 1
+        if hook and seen[0] == trigger_at:
+            hook[0].reshard_to("2x4")  # lint: allow-actuate
+        x = np.frombuffer(rec["bytes"], np.float32)
+        return {"x": x, "y": (x * 0.5).astype(np.float32)}
+
+    def record(batch):
+        tap.append({k: np.array(v) for k, v in batch.items()})
+        return batch
+
+    return (FileSource(str(root))
+            .map(parse)
+            .shuffle(window=16, seed=9)
+            .batch(8, remainder="drop")
+            .map(record)
+            .repeat())
+
+
+def test_run_dataset_live_reshard_mid_epoch(tmp_path):
+    """``reshard_to`` mid-run: the loop drains to a checkpoint + sidecar,
+    rebuilds the trainer on the new mesh, and consumes the SAME batch
+    stream the un-resharded reference does."""
+    root = tmp_path / "vecs"
+    _write_vec_shards(root)
+    total = 10
+
+    ref_tap = []
+    ck_ref = TrainCheckpointer(str(tmp_path / "ck_ref"))
+    ref = ResilientTrainLoop(
+        _trainer_factory(make_mesh(MeshSpec(data=4, tensor=2))),
+        ck_ref, _init_params, save_every=3,
+        trainer_factory=_trainer_factory)
+    s_ref = ref.run_dataset(_reshard_pipeline(root, ref_tap, []), total)
+    ck_ref.close()
+
+    tap, hook = [], []
+    before = obsmetrics.counter("reliability.reshards").value
+    ck = TrainCheckpointer(str(tmp_path / "ck_live"))
+    loop = ResilientTrainLoop(
+        _trainer_factory(make_mesh(MeshSpec(data=4, tensor=2))),
+        ck, _init_params, save_every=3, trainer_factory=_trainer_factory)
+    hook.append(loop)
+    s_live = loop.run_dataset(_reshard_pipeline(root, tap, hook), total)
+    ck.close()
+
+    # the trainer really moved placements, once
+    assert dict(loop.trainer.mesh.shape)["tensor"] == 4
+    assert obsmetrics.counter("reliability.reshards").value == before + 1
+    # the batch stream through the reshard is bit-identical to the
+    # reference's
+    assert len(tap) == len(ref_tap) == total
+    for a, b in zip(tap, ref_tap):
+        _batches_equal(a, b)
+    # and the learned state matches the single-mesh run up to placement
+    # reduction order
+    fa, _ = jax.tree_util.tree_flatten(jax.device_get(s_ref))
+    fb, _ = jax.tree_util.tree_flatten(jax.device_get(s_live))
+    for x, y in zip(fa, fb):
+        assert np.allclose(x, y, rtol=0, atol=2e-5)
+
+
+def test_run_dataset_killed_mid_reshard_restores_on_new_mesh(tmp_path):
+    """A run SIGKILLed mid-reshard (after the drain commits, before the
+    new trainer exists) restarts ON THE NEW mesh shape and replays the
+    interrupted batch stream bit-for-bit — final state bit-identical to a
+    run whose reshard survived."""
+    root = tmp_path / "vecs"
+    _write_vec_shards(root)
+    total = 10
+
+    # run A: the live reshard that survives — the bit-identity reference
+    tap_a, hook_a = [], []
+    ck_a = TrainCheckpointer(str(tmp_path / "ck_live"))
+    loop_a = ResilientTrainLoop(
+        _trainer_factory(make_mesh(MeshSpec(data=4, tensor=2))),
+        ck_a, _init_params, save_every=3, trainer_factory=_trainer_factory)
+    hook_a.append(loop_a)
+    s_a = loop_a.run_dataset(_reshard_pipeline(root, tap_a, hook_a), total)
+    ck_a.close()
+
+    # run B: identical, but the process dies mid-reshard
+    boom = [True]
+
+    def dying_factory(mesh):
+        if boom:
+            boom.clear()
+            raise RuntimeError("SIGKILL mid-reshard")
+        return _trainer_factory(mesh)
+
+    tap_b, hook_b = [], []
+    ck_b = TrainCheckpointer(str(tmp_path / "ck_kill"))
+    loop_b = ResilientTrainLoop(
+        _trainer_factory(make_mesh(MeshSpec(data=4, tensor=2))),
+        ck_b, _init_params, save_every=3, trainer_factory=dying_factory)
+    hook_b.append(loop_b)
+    with pytest.raises(RuntimeError, match="mid-reshard"):
+        loop_b.run_dataset(_reshard_pipeline(root, tap_b, hook_b), total)
+    ck_b.wait()
+    died_at = ck_b.latest_step()
+    assert 0 < died_at < total                       # the drain committed
+    assert ck_b.get_data_state(died_at) is not None  # sidecar travelled
+    ck_b.close()
+
+    # process-equivalent restart ON the requested shape: fresh
+    # checkpointer, fresh pipeline, NO trigger (the reshard already
+    # landed in the checkpoint)
+    tap_c = []
+    ck_c = TrainCheckpointer(str(tmp_path / "ck_kill"))
+    loop_c = ResilientTrainLoop(
+        _trainer_factory(make_mesh(parse_mesh_shape("2x4"))),
+        ck_c, _init_params, save_every=3, trainer_factory=_trainer_factory)
+    s_c = loop_c.run_dataset(_reshard_pipeline(root, tap_c, []), total)
+    ck_c.close()
+
+    # the restart replays the tail of the SAME stream the survivor saw...
+    assert len(tap_b) == died_at
+    assert len(tap_c) == total - died_at
+    for a, b in zip(tap_a, tap_b + tap_c):
+        _batches_equal(a, b)
+    # ...and lands on the bit-identical final state
+    assert _tree_equal(s_a, s_c)

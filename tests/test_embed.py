@@ -499,3 +499,83 @@ def test_zoo_spec_padding_and_packing():
     x = pack_rows(dense, [ids])
     assert x.dtype == np.float32 and x.shape == (2, 6)
     assert np.array_equal(x[:, 4:].astype(np.int64), ids)
+
+
+# -- per-row residency: frequency-capped cold-first eviction ------------------
+
+def _residency(_ledger, rows=32, dim=4, cap=4, freq_cap=3):
+    from mmlspark_tpu.embed.tables import RowResidency
+    rng = np.random.default_rng(7)
+    master = rng.normal(size=(rows, dim)).astype(np.float32)
+    return master, RowResidency("pool", master, capacity_rows=cap,
+                                freq_cap=freq_cap, ledger=_ledger)
+
+
+def test_row_residency_bit_identical_and_ledger_tracks(_ledger):
+    master, pool = _residency(_ledger)
+    ids = [1, 5, 1, 9, 5, 2]
+    out = pool.lookup(ids)
+    # rows come back bit-identical to direct master indexing
+    assert np.array_equal(out, master[ids])
+    # the ledger carries exactly the resident rows as kind="table"
+    row_b = master[0].nbytes
+    assert pool.resident_rows == 4
+    assert _ledger.total(model="pool", kind="table") == 4 * row_b
+    # hit/miss split: 4 distinct ids admitted, 2 repeats hit
+    s = pool.stats()
+    assert s["misses"] == 4 and s["hits"] == 2 and s["evictions"] == 0
+
+
+def test_row_residency_evicts_cold_rows_first(_ledger):
+    master, pool = _residency(_ledger, cap=3)
+    pool.lookup([1, 2, 3])       # fill: all freq 1
+    pool.lookup([2, 3])          # 1 is now the coldest (freq 1, stalest)
+    pool.lookup([4])             # over capacity -> the COLD row goes
+    assert pool.evictions == 1
+    assert set(pool._slot) == {2, 3, 4}
+    # the evicted row still serves (readmitted from the master),
+    # bit-identically
+    assert np.array_equal(pool.lookup([1]), master[[1]])
+    # partial eviction: the ledger line shrinks to the pool, never to a
+    # whole-table drop
+    assert _ledger.total(model="pool", kind="table") == 3 * master[0].nbytes
+
+
+def test_row_residency_frequency_cap_bounds_stale_heat(_ledger):
+    # row 1 is touched far past the cap; once the working set shifts,
+    # capped frequency + recency tiebreak turn it over in O(capacity)
+    # admissions — the uncapped-LFU "pinned forever" failure is the bug
+    # this guards against
+    master, pool = _residency(_ledger, cap=3, freq_cap=3)
+    pool.lookup([1] * 50)                  # freq capped at 3, not 50
+    assert pool._freq[1] == 3
+    pool.lookup([2, 3])                    # fill
+    for rid in (4, 5, 6):                  # new working set, touched to cap
+        pool.lookup([rid] * 3)
+    assert 1 not in pool._slot             # the stale-hot row turned over
+    assert pool.resident_rows == 3
+
+
+def test_row_residency_close_reconciles_to_zero(_ledger):
+    master, pool = _residency(_ledger)
+    pool.lookup([1, 2, 3, 4, 5])           # admissions + one eviction
+    assert _ledger.total(model="pool", kind="table") > 0
+    pool.close()
+    # the PR 17 invariant at row granularity: close leaves ZERO bytes
+    assert _ledger.total(model="pool") == 0
+    assert _ledger.total(kind="table") == 0
+    pool.close()                           # idempotent
+    with pytest.raises(RuntimeError):
+        pool.lookup([1])
+
+
+def test_row_residency_eviction_order_deterministic(_ledger):
+    from mmlspark_tpu.observability.memory import MemoryLedger
+    seqs = []
+    for _ in range(2):
+        master, pool = _residency(MemoryLedger(), rows=64, cap=4)
+        rng = np.random.default_rng(11)
+        for _step in range(40):
+            pool.lookup(rng.integers(1, 64, size=3).tolist())
+        seqs.append((pool.evictions, sorted(pool._slot)))
+    assert seqs[0] == seqs[1]

@@ -27,7 +27,8 @@ def test_all_examples_present():
     found = sorted(f for f in os.listdir(EXAMPLES_DIR)
                    if f[0].isdigit() and f.endswith(".py"))
     assert [f.split("_")[0] for f in found] == [
-        "101", "102", "103", "201", "202", "301", "302", "303", "304"]
+        "101", "102", "103", "201", "202", "301", "302", "303", "304",
+        "305"]
 
 
 def test_101_census():
@@ -95,3 +96,11 @@ def test_304_distributed_training():
     # one global program: both launcher processes agree exactly
     assert out[0] == out[1]
     assert out[0]["accuracy"] > 0.85
+
+
+def test_305_streaming_recommender():
+    out = _run("305_streaming_recommender.py")
+    # FileSource shards -> HashIndexer ids -> packed rows -> DLRM: the
+    # streamed pipeline trains (loss decreases over the 4 epochs)
+    assert out["batches"] == 24
+    assert out["loss_last"] < out["loss_first"]

@@ -533,3 +533,154 @@ def test_cli_chaos_fleet_flag(tmp_path, capsys):
                  "--requests", "16", "--out", str(out)]) == 0
     verdict = json.loads((out / "chaos_verdict.json").read_text())
     assert verdict["scenario"] == "fleet" and verdict["passed"] is True
+
+
+# -- elastic mesh: Fleet.reshard ----------------------------------------------
+
+def test_fleet_reshard_bit_identical_zero_downtime():
+    """Live reshard onto a (data=4, tensor=2) placement under concurrent
+    fire: zero failed requests, scores bit-identical to the un-resharded
+    reference throughout, every replica resharded."""
+    m = make_model()
+    x = np.zeros((1, 8), np.float32)
+    with Server({"mlp": m}, max_batch=4) as ref:
+        want = ref.submit("mlp", x, timeout=30)
+
+    fleet = Fleet({"mlp": m}, replicas=2, server_kwargs={"max_batch": 4})
+    errs, stop = [], threading.Event()
+
+    def fire():
+        while not stop.is_set():
+            try:
+                np.testing.assert_array_equal(fleet.submit("mlp", x), want)
+            except Exception as e:
+                errs.append(e)
+                return
+
+    try:
+        t = threading.Thread(target=fire, daemon=True)
+        t.start()
+        report = fleet.reshard("4x2", warm_x=x)  # lint: allow-actuate
+        stop.set()
+        t.join(timeout=10)
+        assert errs == []                # zero failed requests under fire
+        assert [r["status"] for r in report["replicas"]] == \
+            ["resharded", "resharded"]
+        assert report["mesh_shape"] == "4x2" == fleet.mesh_shape
+        # the model actually moved: the SAME checkpoint now carries a
+        # sharded placement, and per-chip residency dropped below logical
+        entry = fleet.servers[0].registry.get("mlp")
+        spec = entry.model.get("meshSpec")
+        assert (spec.data, spec.tensor) == (4, 2)
+        assert entry.model._resolve_score_mesh().shape["tensor"] == 2
+        # post-reshard scores stay bit-identical
+        for _ in range(3):
+            np.testing.assert_array_equal(fleet.submit("mlp", x), want)
+        # a scale-up after the reshard builds on the NEW placement
+        name = fleet.scale_up()            # lint: allow-actuate
+        new_spec = fleet.servers[-1].registry.get("mlp").model.get(
+            "meshSpec")
+        assert (new_spec.data, new_spec.tensor) == (4, 2)
+    finally:
+        stop.set()
+        fleet.close()
+
+
+def test_fleet_reshard_over_budget_degrades_to_noop():
+    """A target placement that cannot fit ``runtime.device_cache_mb``
+    raises ``PlacementOverBudget`` BEFORE any entry is dropped: every
+    replica keeps serving its current placement (no eviction storm)."""
+    from mmlspark_tpu.serve.registry import PlacementOverBudget
+    m = make_model()
+    x = np.zeros((1, 8), np.float32)
+    with Fleet({"mlp": m}, replicas=2,
+               server_kwargs={"max_batch": 4}) as fleet:
+        want = fleet.submit("mlp", x)
+        prior = config.get("runtime.device_cache_mb")
+        config.set("runtime.device_cache_mb", 1e-6)   # ~1 byte budget
+        try:
+            with pytest.raises(PlacementOverBudget):
+                fleet.reshard("4x2", warm_x=x)  # lint: allow-actuate
+        finally:
+            config.set("runtime.device_cache_mb", prior)
+        # no-op semantics: old placement still serving, bit-identical,
+        # both replicas in rotation, fleet-level shape unchanged
+        assert fleet.mesh_shape == ""
+        assert fleet.router._handles["r0"].weight == 1.0
+        assert fleet.servers[0].registry.get("mlp").model.get(
+            "meshSpec") in (None, "")
+        np.testing.assert_array_equal(fleet.submit("mlp", x), want)
+
+
+def test_fleet_reshard_skips_dead_and_records_them():
+    m = make_model()
+    x = np.zeros((1, 8), np.float32)
+    with Fleet({"mlp": m}, replicas=3,
+               server_kwargs={"max_batch": 4}) as fleet:
+        want = fleet.submit("mlp", x)
+        fleet.kill(1)
+        report = fleet.reshard("4x2", warm_x=x)  # lint: allow-actuate
+        assert [r["status"] for r in report["replicas"]] == \
+            ["resharded", "skipped_dead", "resharded"]
+        assert report["resharded"] == 2
+        np.testing.assert_array_equal(fleet.submit("mlp", x), want)
+
+
+def test_fleet_reshard_back_to_single_device():
+    """``reshard(None)`` returns to the single-device fast path — the
+    narrow direction of the autopilot's lever, round-tripped."""
+    m = make_model()
+    x = np.zeros((1, 8), np.float32)
+    with Fleet({"mlp": m}, replicas=2,
+               server_kwargs={"max_batch": 4}) as fleet:
+        want = fleet.submit("mlp", x)
+        fleet.reshard("4x2", warm_x=x)     # lint: allow-actuate
+        np.testing.assert_array_equal(fleet.submit("mlp", x), want)
+        report = fleet.reshard(None, warm_x=x)  # lint: allow-actuate
+        assert report["mesh_shape"] == "" == fleet.mesh_shape
+        assert fleet.servers[0].registry.get("mlp").model.get(
+            "meshSpec") in (None, "")
+        np.testing.assert_array_equal(fleet.submit("mlp", x), want)
+
+
+def test_registry_replace_rejects_over_budget_before_drop():
+    """The satellite's latent-bug fix in isolation: ``replace`` with a
+    placement whose projected per-shard bytes exceed the budget raises
+    and the OLD entry keeps serving — it is never popped."""
+    from mmlspark_tpu.serve.registry import (ModelRegistry,
+                                             PlacementOverBudget)
+    reg = ModelRegistry()
+    m_old = make_model(seed=0)
+    entry = reg.add("mlp", m_old)
+    entry.ensure_apply()
+    prior = config.get("runtime.device_cache_mb")
+    config.set("runtime.device_cache_mb", 1e-6)
+    try:
+        with pytest.raises(PlacementOverBudget):
+            reg.replace("mlp", make_model(seed=1), "v2")
+    finally:
+        config.set("runtime.device_cache_mb", prior)
+    # the old entry was never dropped; version and apply intact
+    assert reg.get("mlp") is entry
+    assert reg.versions() == {"mlp": "v1"}
+
+
+def test_chaos_reshard_scenario_is_deterministic(tmp_path):
+    """The elastic-mesh headline: a SIGKILL lands mid-reshard under fire
+    and the verdict is green — zero failed requests, bit-identical on
+    both placements, ledger reconciled — with a seed-pure schedule."""
+    from mmlspark_tpu.reliability import chaos
+
+    v1 = chaos.run_reshard_scenario(0, str(tmp_path / "a"), requests=12)
+    metrics.get_registry().reset()
+    v2 = chaos.run_reshard_scenario(0, str(tmp_path / "b"), requests=12)
+    for v in (v1, v2):
+        assert v["passed"], v["invariants"]
+        assert v["invariants"]["kill_landed_mid_reshard"]
+        assert v["invariants"]["fired_through_reshard"]
+        assert v["invariants"]["ledger_reconciles_on_close"]
+    # reshard point, victim, and per-replica statuses replay byte-for-byte
+    assert v1["schedule"] == v2["schedule"]
+    on_disk = json.loads(
+        (tmp_path / "a" / chaos.VERDICT_FILE).read_text())
+    assert on_disk["passed"] is True

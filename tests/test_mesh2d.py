@@ -117,10 +117,16 @@ def test_mesh_shape_config_selects_2d_topology():
         config.set("parallel.mesh_shape", prior)
     spec = parse_mesh_shape("-1x2")
     assert spec.data == -1 and spec.tensor == 2
+    # three factors = (data, tensor, pipe) — the elastic-mesh 3-D form
+    spec3 = parse_mesh_shape("2x2x2")
+    assert (spec3.data, spec3.tensor, spec3.pipe) == (2, 2, 2)
+    mesh3 = make_mesh(spec3)
+    assert (mesh3.shape["data"], mesh3.shape["tensor"],
+            mesh3.shape["pipe"]) == (2, 2, 2)
     with pytest.raises(ValueError):
-        parse_mesh_shape("4x2x2")
+        parse_mesh_shape("4x2x2x2")        # at most three factors
     with pytest.raises(ValueError):
-        parse_mesh_shape("4x-1")
+        parse_mesh_shape("4x-1")           # only data may be -1
 
 
 # -- serving: sharded lane bit-identity --------------------------------------
@@ -233,3 +239,89 @@ def test_per_shard_bytes_sum_to_unsharded_total():
     assert total_sharded == devmem.param_shard_bytes(state)
     # tensor sharding makes the per-chip charge strictly smaller
     assert total_sharded < total_logical
+
+
+# -- 3-D (data, tensor, pipe) topology ----------------------------------------
+
+def _pipe_stage(p, x):
+    h = jnp.tanh(x @ p["mlp_up_kernel"])
+    return x + h @ p["mlp_down_kernel"]
+
+
+def _pipe_host_state(optimizer, d=16, hidden=32, n_stages=4):
+    """One eager host init every topology loads: a stacked pipelined
+    residual-MLP body under ``stages/`` plus an out-of-pipeline head."""
+    rng = np.random.default_rng(0)
+    stages = {
+        "mlp_up_kernel": jnp.asarray(rng.normal(
+            0, d ** -0.5, size=(n_stages, d, hidden)), jnp.float32),
+        "mlp_down_kernel": jnp.asarray(rng.normal(
+            0, hidden ** -0.5, size=(n_stages, hidden, d)), jnp.float32),
+    }
+    params = {"stages": stages,
+              "head_kernel": jnp.asarray(
+                  rng.normal(0, d ** -0.5, size=(d, 1)), jnp.float32)}
+    return {"params": params, "opt_state": optimizer.init(params),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def _pipe_trainer(mesh_spec, d=16):
+    from mmlspark_tpu.parallel.pipeline_parallel import pipeline_apply
+    from mmlspark_tpu.parallel.sharding import pipeline_stacked_rules
+    mesh = make_mesh(mesh_spec)
+
+    def loss_fn(params, batch, rng):
+        h = pipeline_apply(_pipe_stage, params["stages"], batch["x"],
+                           mesh, n_microbatches=2)
+        pred = (h @ params["head_kernel"])[:, 0]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    opt = optax.adam(1e-2)
+    trainer = DistributedTrainer(loss_fn, opt, mesh=mesh,
+                                 rules=pipeline_stacked_rules())
+    host = _pipe_host_state(opt, d=d)
+    _, shardings = trainer.abstract_state(
+        lambda: jax.tree_util.tree_map(jnp.zeros_like,
+                                       host["params"]))
+    state = jax.device_put(host, shardings)
+    return trainer, state
+
+
+def _run_pipe_losses(trainer, state, steps=3, d=16):
+    out = []
+    for i in range(steps):
+        rng_np = np.random.default_rng(40 + i)
+        batch = {"x": rng_np.normal(size=(8, d)).astype(np.float32),
+                 "y": rng_np.normal(size=(8,)).astype(np.float32)}
+        state, m = trainer.train_step(state, trainer.put_batch(batch),
+                                      jax.random.PRNGKey(0))
+        out.append(float(jax.device_get(m["loss"])))
+    return state, out
+
+
+def test_train_3d_pipeline_topology_matches_1d_reference():
+    """The elastic-mesh 3-D composition: ``parse_mesh_shape("2x2x2")``
+    lands a (data=2, tensor=2, pipe=2) topology, ``pipeline_stacked_rules``
+    keeps ``param_shardings`` the single placement home (Rule 14), and
+    training losses match the 1-D data-parallel reference."""
+    tr1, s1 = _pipe_trainer(MeshSpec(data=8))
+    tr3, s3 = _pipe_trainer(parse_mesh_shape("2x2x2"))
+    # same host values landed on both meshes
+    assert np.array_equal(
+        np.asarray(jax.device_get(s1["params"]["stages"]["mlp_up_kernel"])),
+        np.asarray(jax.device_get(s3["params"]["stages"]["mlp_up_kernel"])))
+    # the stacked stage leaves carry pipe FIRST, tensor on the feature dim
+    up_spec = tuple(
+        s3["params"]["stages"]["mlp_up_kernel"].sharding.spec)
+    assert up_spec[0] == "pipe" and "tensor" in up_spec
+    # the out-of-pipeline head falls through to the base rules (no pipe)
+    head_spec = tuple(s3["params"]["head_kernel"].sharding.spec)
+    assert "pipe" not in head_spec
+    # per-chip residency strictly below logical bytes on the 3-D mesh
+    assert devmem.param_shard_bytes(s3["params"]) < \
+        devmem.param_bytes(s3["params"])
+    _, l1 = _run_pipe_losses(tr1, s1)
+    _, l3 = _run_pipe_losses(tr3, s3)
+    assert all(np.isfinite(v) for v in l1 + l3)
+    np.testing.assert_allclose(l1, l3, rtol=0, atol=2e-5)
+    assert l3[-1] < l3[0]

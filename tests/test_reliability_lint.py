@@ -715,6 +715,38 @@ def test_actuate_rule_lever_homes_exempt():
     """), filename="mmlspark_tpu/reliability/chaos.py") == []
 
 
+# -- Rule 15 extension: elastic-mesh reshard is an actuator -------------------
+
+def test_actuate_rule_flags_reshard_levers():
+    src = textwrap.dedent("""
+        def rogue(fleet, loop):
+            fleet.reshard("2x4")
+            loop.reshard_to("4x2")
+    """)
+    probs = lint.check_source(
+        src, filename="mmlspark_tpu/serve/http.py")
+    assert len(probs) == 2
+    assert all("actuator" in p for p in probs)
+
+
+def test_actuate_rule_reshard_homes_and_escape():
+    # the autopilot (the decision loop) and the fleet own the lever
+    assert lint.check_source(textwrap.dedent("""
+        def _actuate(self, d):
+            self.fleet.reshard(d["target"])
+    """), filename="mmlspark_tpu/control/autopilot.py") == []
+    assert lint.check_source(textwrap.dedent("""
+        def reshard(self, mesh_shape):
+            return self._do_reshard(mesh_shape)
+    """), filename="mmlspark_tpu/serve/fleet.py") == []
+    # chaos / operator scripts opt in per-line
+    assert lint.check_source(textwrap.dedent("""
+        def scenario(fleet, loop):
+            fleet.reshard("2x4")  # lint: allow-actuate
+            loop.reshard_to("4x2")  # lint: allow-actuate
+    """), filename="mmlspark_tpu/reliability/chaos.py") == []
+
+
 def test_process_rule_launcher_home_exempt():
     # Rule 12: the host launcher is a sanctioned process-management home
     src = textwrap.dedent("""

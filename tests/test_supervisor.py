@@ -418,7 +418,7 @@ def test_chaos_scenario_registry_covers_all_runners():
     assert set(chaos.SCENARIOS) == {"train", "fleet", "decode", "host",
                                     "fleet_sharded", "decode_sharded",
                                     "autopilot", "elastic", "recommender",
-                                    "fleetprefix"}
+                                    "fleetprefix", "reshard"}
     assert all(desc for desc in chaos.SCENARIOS.values())
 
 
